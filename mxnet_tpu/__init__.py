@@ -1,0 +1,29 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the MXNet 1.x API.
+
+A ground-up rebuild of the capabilities of ROCmSoftwarePlatform/mxnet
+(Apache MXNet 1.x, HIP/ROCm fork) designed for TPU hardware: NDArray storage
+backs onto XLA/PjRt device buffers, operators lower to XLA HLO (with Pallas
+kernels for hot fused ops), hybridized Gluon blocks JIT-compile into single
+XLA computations, and KVStore('device') rides ICI collectives instead of
+NCCL/RCCL.  See SURVEY.md for the component-by-component mapping.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx           # or: import mxnet as mx (shim package)
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+
+
+def waitall():
+    engine.wait_all()

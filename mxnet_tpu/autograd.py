@@ -1,0 +1,338 @@
+"""Autograd: imperative differentiation on an immutable-array runtime.
+
+Reference parity: python/mxnet/autograd.py (record/pause scopes ~L80,
+backward ~L250, grad ~L350, Function) over src/imperative/imperative.cc
+(Imperative::RecordOp ~L200, Imperative::Backward ~L300).
+
+Design (TPU-native): the reference builds an nnvm graph of executed ops and
+runs a Gradient pass.  Here every executed op is recorded as a tape node
+holding the ``jax.vjp`` pullback captured at execution time — capturing the
+pullback *is* the forward execution, so recording costs one forward, exactly
+like the reference (residuals kept, no recompute at backward).  Because jax
+arrays are immutable, a tape node's saved inputs can never be clobbered by
+later in-place NDArray mutation (which swaps buffers) — the correctness
+problem MXNet solves with version counters disappears by construction.
+
+Gradient flow is keyed on the *identity* of the underlying jax arrays.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+]
+
+
+class _TapeNode:
+    __slots__ = ("vjp_fn", "input_ids", "input_arrays", "output_ids", "outputs")
+
+    def __init__(self, vjp_fn, inputs, outputs):
+        self.vjp_fn = vjp_fn
+        self.input_arrays = list(inputs)
+        self.input_ids = [id(a) for a in inputs]
+        self.outputs = list(outputs)
+        self.output_ids = [id(o) for o in outputs]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List[_TapeNode] = []
+        # id(jax array) -> weakref to the NDArray whose .grad should receive it
+        self.leaves: Dict[int, Any] = {}
+
+
+_state = _State()
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_state.recording, _state.training)
+        if self._rec is True and not _state.recording:
+            # Entering a fresh outermost record scope: drop any stale graph
+            # from a prior forward that never ran backward (MXNet drops the
+            # recorded graph when a new recording starts).
+            _state.tape = []
+            _state.leaves = {}
+        if self._rec is not None:
+            _state.recording = self._rec
+        if self._train is not None:
+            _state.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _state.recording, _state.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope in which executed ops are recorded for backward()."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev = _state.recording
+    _state.recording = flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev = _state.training
+    _state.training = flag
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# tape construction (called from ops.registry on every eager op)
+# ---------------------------------------------------------------------------
+def record_node(vjp_fn, inputs, outputs, input_nds=None) -> None:
+    _state.tape.append(_TapeNode(vjp_fn, inputs, outputs))
+    if input_nds:
+        for nd in input_nds:
+            register_leaf(nd)
+
+
+def register_leaf(nd) -> None:
+    """If `nd` has an attached grad buffer, remember the data object identity
+    under which it entered the graph (mutation swaps buffers, so identity at
+    use-time is the correct key)."""
+    if getattr(nd, "_grad", None) is not None:
+        _state.leaves[id(nd._data)] = weakref.ref(nd)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Reference: autograd.mark_variables — associate arrays with grad buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g if req != "null" else None
+        var._grad_req = req
+        if var._grad is not None:
+            _state.leaves[id(var._data)] = weakref.ref(var)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _is_float0(arr) -> bool:
+    import jax
+
+    return getattr(arr, "dtype", None) == jax.dtypes.float0
+
+
+def _walk_tape(head_pairs, retain_graph=False):
+    """Reverse-walk the tape accumulating cotangents.
+
+    head_pairs: list of (jax array, cotangent jax array).
+    Returns dict id(array) -> accumulated cotangent.
+    """
+    import jax.numpy as jnp
+
+    grads: Dict[int, Any] = {}
+    keep: Dict[int, Any] = {}  # strong refs so id() keys stay unique/alive
+    for arr, ct in head_pairs:
+        grads[id(arr)] = ct
+        keep[id(arr)] = arr
+
+    tape = _state.tape
+    for node in reversed(tape):
+        if not any(oid in grads for oid in node.output_ids):
+            continue
+        cts = []
+        for out, oid in zip(node.outputs, node.output_ids):
+            g = grads.get(oid)
+            if g is None:
+                g = jnp.zeros_like(out)
+            cts.append(g)
+        in_grads = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
+        for arr, aid, g in zip(node.input_arrays, node.input_ids, in_grads):
+            if g is None or _is_float0(g):
+                continue
+            if aid in grads:
+                grads[aid] = grads[aid] + g
+            else:
+                grads[aid] = g
+                keep[aid] = arr
+    if not retain_graph:
+        _state.tape = []
+    return grads
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True) -> None:
+    """Compute gradients of `heads` w.r.t. all attach_grad()-ed arrays on the
+    tape, writing into their .grad buffers per grad_req ('write'|'add').
+
+    Reference: MXAutogradBackwardEx -> Imperative::Backward (~L300).
+    """
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    import jax.numpy as jnp
+
+    pairs = []
+    for h, hg in zip(heads, head_grads):
+        ct = hg._data if hg is not None else jnp.ones_like(h._data)
+        pairs.append((h._data, ct))
+
+    grads = _walk_tape(pairs, retain_graph=retain_graph)
+
+    leaves, _state.leaves = _state.leaves, {}
+    for aid, ref in leaves.items():
+        nd = ref()
+        if nd is None or nd._grad is None:
+            continue
+        g = grads.get(aid)
+        if g is None:
+            continue
+        if nd._grad_req == "add":
+            nd._grad._set_data(nd._grad._data + g.astype(nd._grad._data.dtype))
+        else:
+            nd._grad._set_data(g.astype(nd._grad._data.dtype))
+    if retain_graph:
+        _state.leaves = leaves
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference: autograd.grad ~L350).
+
+    ``create_graph=True`` (higher-order eager grad) is not supported; use the
+    functional ``mx.jit.grad`` path for higher-order derivatives.
+    """
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True is not supported by the eager tape; "
+            "use jax.grad via hybridized blocks for higher-order gradients"
+        )
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    import jax.numpy as jnp
+
+    pairs = []
+    for h, hg in zip(heads, head_grads):
+        ct = hg._data if hg is not None else jnp.ones_like(h._data)
+        pairs.append((h._data, ct))
+    grads = _walk_tape(pairs, retain_graph=bool(retain_graph))
+
+    out = []
+    for v in variables:
+        g = grads.get(id(v._data))
+        if g is None:
+            raise MXNetError(
+                "one of the variables is not part of the recorded graph"
+            )
+        out.append(NDArray(g, ctx=v.context))
+    return out[0] if single else out
+
+
+# ---------------------------------------------------------------------------
+# custom Function
+# ---------------------------------------------------------------------------
+class Function:
+    """User-defined differentiable function (reference: autograd.Function ~L350).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` in terms of NDArrays; call the instance.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause(train_mode=is_training()):
+            outputs = self.forward(*inputs)
+        single_out = isinstance(outputs, NDArray)
+        outs = [outputs] if single_out else list(outputs)
+
+        if is_recording():
+            func = self
+            in_arrays = [x._data for x in inputs]
+            out_arrays = [o._data for o in outs]
+            ctx = inputs[0].context if inputs else None
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ct_nds = [NDArray(c, ctx=ctx) for c in cts]
+                with pause(train_mode=is_training()):
+                    in_grads = func.backward(*ct_nds)
+                if isinstance(in_grads, NDArray):
+                    in_grads = [in_grads]
+                return [g._data if g is not None else None for g in in_grads]
+
+            record_node(vjp_fn, in_arrays, out_arrays, input_nds=inputs)
+        return outputs

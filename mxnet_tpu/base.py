@@ -1,0 +1,114 @@
+"""Foundation utilities for mxnet_tpu.
+
+TPU-native rebuild of the roles played by ``python/mxnet/base.py`` and
+``3rdparty/dmlc-core`` in the reference (ctypes lib loading, error state,
+dtype maps).  There is no C ABI here: the "library" below us is JAX/XLA, so
+this module only holds dtype plumbing, env-var helpers and shared errors.
+
+Reference parity: python/mxnet/base.py (~L100-300), dmlc parameter defaults.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "shape_types",
+    "dtype_np",
+    "dtype_name",
+    "env_int",
+    "env_str",
+    "env_bool",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: MXGetLastError surfaced errors)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+shape_types = (tuple, list)
+
+# MXNet 1.x dtype universe (reference: include/mxnet/base.h mshadow type switch).
+# bfloat16 is promoted to a first-class citizen for TPU.
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": None,  # resolved lazily via ml_dtypes to avoid jax import here
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def _bfloat16():
+    import ml_dtypes  # shipped with jax
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def dtype_np(dtype: Any) -> np.dtype:
+    """Normalize a user-supplied dtype (string, np.dtype, type) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return _bfloat16()
+        if dtype in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[dtype])
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype: Any) -> str:
+    """Canonical string name for a dtype."""
+    d = dtype_np(dtype)
+    return d.name
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def canonical_kwargs(kwargs: dict) -> Tuple:
+    """Hashable, order-independent key for an op's attribute dict.
+
+    Used to key per-op jit caches (reference analog: op param struct hashing
+    feeding CachedOp signatures, src/imperative/cached_op.cc ~L200).
+    """
+    items = []
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        elif isinstance(v, np.dtype):
+            v = v.name
+        elif isinstance(v, type):
+            v = np.dtype(v).name
+        items.append((k, v))
+    return tuple(items)

@@ -1,0 +1,159 @@
+"""Device contexts.
+
+Reference parity: python/mxnet/context.py (Context, mx.cpu()/mx.gpu(i),
+thread-local default context, num_gpus ~L1-300).
+
+TPU-native mapping:
+  * ``mx.tpu(i)``  -> i-th accelerator device reported by jax (the north-star
+    first-class context from BASELINE.json).
+  * ``mx.gpu(i)``  -> alias of ``mx.tpu(i)``: reference scripts that say
+    ``mx.gpu(0)`` should run unmodified on the accelerator that is present.
+  * ``mx.cpu(i)``  -> i-th jax CPU device (host).
+  * ``mx.cpu_pinned()`` -> host CPU (PjRt manages pinned staging internally).
+
+A Context is resolved lazily to a ``jax.Device`` so importing mxnet_tpu does
+not force backend initialization (tests re-point jax at a virtual CPU mesh
+before first use).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = [
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "cpu_pinned",
+    "current_context",
+    "num_gpus",
+    "num_tpus",
+]
+
+_ACCEL_TYPES = ("tpu", "gpu")
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class Context:
+    """A device context; compares by (device_type, device_id)."""
+
+    _default_ctx = threading.local()
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "cpu_shared", 5: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devstr2type:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+        self._old_ctx: Optional["Context"] = None
+
+    # -- identity ----------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- jax resolution ----------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazy; raises if absent)."""
+        jax = _jax()
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                # Platform list restricted (e.g. JAX_PLATFORMS=axon): fall back
+                # to the default backend so cpu-context code still runs.
+                devs = jax.devices()
+            return devs[min(self.device_id, len(devs) - 1)]
+        # accelerator: gpu is an alias for whatever accelerator jax exposes
+        devs = _accel_devices()
+        if not devs:
+            raise MXNetError(
+                f"{self} requested but no accelerator device is visible to jax"
+            )
+        if self.device_id >= len(devs):
+            raise MXNetError(f"{self} out of range: {len(devs)} device(s) visible")
+        return devs[self.device_id]
+
+    # -- scope -------------------------------------------------------------
+    def __enter__(self):
+        self._old_ctx = current_context()
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+    def empty_cache(self):
+        """Reference: mx.context.Context.empty_cache; PjRt pools internally."""
+
+    def memory_stats(self):
+        dev = self.jax_device
+        stats = getattr(dev, "memory_stats", None)
+        return stats() if stats else None
+
+
+def _accel_devices() -> List:
+    jax = _jax()
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform not in ("cpu",)]
+    if accel:
+        return accel
+    # CPU-only process (tests): accelerator contexts map onto host devices so
+    # the same model code runs under the virtual device mesh.
+    return devs
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_gpus() -> int:
+    """Number of accelerator devices (gpu alias — see module docstring)."""
+    try:
+        return len([d for d in _jax().devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0
+
+
+def num_tpus() -> int:
+    return num_gpus()
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value") or Context._default_ctx.value is None:
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value

@@ -1,0 +1,55 @@
+"""Execution-engine facade.
+
+Reference parity: src/engine/ (ThreadedEnginePerDevice / NaiveEngine,
+MXNET_ENGINE_TYPE selection — engine.cc CreateEngine ~L40; WaitForVar /
+WaitForAll — threaded_engine.cc ~L300).
+
+On TPU the dependency engine's job — async dispatch, per-device streams,
+read/write hazard ordering — is performed by PjRt: jax dispatches
+asynchronously and orders operations on each device stream by construction,
+and our NDArray mutation model (buffer swap, never in-place writes) removes
+write hazards entirely.  What remains here:
+
+  * ``NaiveEngine`` semantics: ``MXNET_ENGINE_TYPE=NaiveEngine`` makes every
+    op synchronous (block_until_ready after dispatch) — the serial oracle the
+    reference uses for race debugging (SURVEY §5.2).
+  * ``wait_all`` / per-array ``wait_to_read`` barriers.
+"""
+from __future__ import annotations
+
+import weakref
+
+from .base import env_str
+
+__all__ = ["is_naive", "set_engine_type", "track", "wait_all"]
+
+_ENGINE_TYPE = env_str("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+# Live arrays that may have outstanding async work; wait_all blocks on them.
+_live: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def is_naive() -> bool:
+    return _ENGINE_TYPE == "NaiveEngine"
+
+
+def set_engine_type(name: str) -> None:
+    global _ENGINE_TYPE
+    _ENGINE_TYPE = name
+
+
+def track(nd) -> None:
+    """Register an NDArray for wait_all barriers."""
+    _live.add(nd)
+
+
+def wait_all() -> None:
+    """Block until all outstanding device work is complete.
+
+    Reference: MXNDArrayWaitAll -> Engine::WaitForAll.
+    """
+    for nd in list(_live):
+        try:
+            nd.wait_to_read()
+        except Exception:
+            pass

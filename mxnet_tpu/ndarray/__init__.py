@@ -1,0 +1,188 @@
+"""The ``mx.nd`` namespace.
+
+Reference parity: python/mxnet/ndarray/ — the op namespace is *generated
+from the registry at import time*, matching the reference's autogen from
+MXSymbolListAtomicSymbolCreators (ndarray/register.py ~L100): every
+registered operator becomes a module-level function here.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Optional
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from ..ops import registry as _reg
+from .. import engine as _engine
+from .ndarray import NDArray, array, from_jax
+from . import random  # noqa: F401  (nd.random namespace)
+from .utils import save, load
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "save", "load", "waitall", "concat", "stack",
+           "from_jax"]
+
+
+def waitall():
+    _engine.wait_all()
+
+
+# ---------------------------------------------------------------------------
+# creation helpers with ctx/dtype signature parity
+# ---------------------------------------------------------------------------
+def zeros(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    return _reg.invoke_by_name("_zeros", [], ctx=ctx, shape=_tup(shape),
+                               dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    return _reg.invoke_by_name("_ones", [], ctx=ctx, shape=_tup(shape),
+                               dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    return _reg.invoke_by_name("_full", [], ctx=ctx, shape=_tup(shape),
+                               value=float(val), dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx: Optional[Context] = None,
+           dtype=None) -> NDArray:
+    return _reg.invoke_by_name("_arange", [], ctx=ctx, start=start, stop=stop,
+                               step=step, repeat=repeat,
+                               dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def eye(N, M=0, k=0, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    return _reg.invoke_by_name("_eye", [], ctx=ctx, N=N, M=M, k=k,
+                               dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def linspace(start, stop, num, endpoint=True, ctx: Optional[Context] = None,
+             dtype=None) -> NDArray:
+    return _reg.invoke_by_name("_linspace", [], ctx=ctx, start=start, stop=stop,
+                               num=num, endpoint=endpoint,
+                               dtype=np.dtype(dtype_np(dtype)).name)
+
+
+def _tup(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# special stubs (train-mode / RNG injection)
+# ---------------------------------------------------------------------------
+def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, out=None,
+            **kwargs):
+    from .. import autograd
+    from .. import random as _rng
+
+    training = kwargs.pop("training", None)
+    if training is None:
+        training = autograd.is_training() or mode == "always"
+    if not training or p <= 0.0:
+        return _reg.invoke_by_name("identity", [data], out=out)
+    key = NDArray(_rng.next_key(), ctx=data.context)
+    return _reg.invoke_by_name("Dropout", [data, key], out=out, p=p, mode=mode,
+                               axes=tuple(axes), training=True)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False, out=None,
+              **kwargs):
+    from .. import autograd
+
+    training = kwargs.pop("training", None)
+    if training is None:
+        training = autograd.is_training()
+    return _reg.invoke_by_name(
+        "BatchNorm", [data, gamma, beta, moving_mean, moving_var], out=out,
+        eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats, output_mean_var=output_mean_var,
+        axis=axis, training=training)
+
+
+def shuffle(data, out=None):
+    from .. import random as _rng
+
+    key = NDArray(_rng.next_key(), ctx=data.context)
+    return _reg.invoke_by_name("_shuffle", [key, data], out=out)
+
+
+_SPECIAL = {"Dropout": Dropout, "BatchNorm": BatchNorm, "_shuffle": shuffle}
+_SKIP_PREFIXES = ("_random_", "_sample_", "sample_")
+
+
+# ---------------------------------------------------------------------------
+# namespace autogen from the op registry
+# ---------------------------------------------------------------------------
+def _make_stub(op):
+    sig = inspect.signature(op.fn)
+    params = list(sig.parameters.values())
+    # NB: builtins like sum/abs/max are shadowed by op stubs in this module's
+    # globals, so avoid them in code that runs after _populate starts.
+    n_arr = 0
+    for p in params:
+        if p.default is p.empty and p.kind == p.POSITIONAL_OR_KEYWORD:
+            n_arr += 1
+    kw_names = [p.name for p in params if p.default is not p.empty]
+
+    def stub(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        ctx = kwargs.pop("ctx", None)
+        kwargs.pop("name", None)  # symbol-compat no-op
+        inputs = []
+        extra_kw = 0
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif len(inputs) < n_arr:
+                # positional slot that must be an array input
+                inputs.append(array(a, ctx=ctx))
+            else:
+                # positional attr: assign to next keyword param not given
+                while extra_kw < len(kw_names) and kw_names[extra_kw] in kwargs:
+                    extra_kw += 1
+                if extra_kw >= len(kw_names):
+                    raise MXNetError(
+                        f"too many positional arguments for op {op.name}")
+                kwargs[kw_names[extra_kw]] = a
+                extra_kw += 1
+        return _reg.invoke(op, inputs, out=out, ctx=ctx, **kwargs)
+
+    stub.__name__ = op.name
+    stub.__doc__ = op.__doc__
+    return stub
+
+
+def _populate():
+    g = globals()
+    for name in _reg.list_ops():
+        if name in _SPECIAL:
+            g[name] = _SPECIAL[name]
+            continue
+        if name.startswith(_SKIP_PREFIXES):
+            continue
+        op = _reg.get_op(name)
+        g[name] = _make_stub(op)
+        __all__.append(name)
+    # common aliases
+    g["concatenate"] = g["Concat"]
+    g["concat"] = g["Concat"]
+    g["flatten"] = g["Flatten"]
+    g["cast"] = g["Cast"]
+    def moveaxis(a, source, destination):
+        import jax.numpy as jnp
+
+        return _reg.invoke_fn(lambda x: jnp.moveaxis(x, source, destination), [a])
+
+    g["moveaxis"] = moveaxis
+
+
+_populate()

@@ -1,0 +1,454 @@
+"""NDArray: the imperative n-dimensional array.
+
+Reference parity: python/mxnet/ndarray/ndarray.py (class NDArray ~L1-2000)
+over src/ndarray/ndarray.cc (Chunk ~L80, CopyFromTo ~L600).
+
+TPU-native design: an NDArray owns an immutable ``jax.Array`` (or a jax
+tracer inside a HybridBlock trace).  MXNet's mutation semantics (``x += y``,
+``x[1:3] = v``, kvstore writing into parameter buffers) are provided by
+*buffer swap*: every mutation computes a new device array and swaps it in,
+bumping a version counter.  Because the underlying buffers never change,
+autograd tape residuals and async readers stay valid with no engine
+write-hazard tracking — the role of the reference's var-version bookkeeping
+(threaded_engine.cc ~L300) is played by immutability itself.
+
+Async semantics come from PjRt: dispatch returns immediately;
+``wait_to_read``/``asnumpy`` block, matching Engine::WaitForVar.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from .. import engine
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "from_jax"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req", "_detached",
+                 "__weakref__")
+
+    # numpy should defer to our reflected dunders
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        self._data = data
+        self._ctx = ctx or current_context()
+        self._version = 0
+        self._grad = None
+        self._grad_req = "write"
+        self._detached = False
+        engine.track(self)
+
+    # ------------------------------------------------------------------
+    # core properties
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype).type
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:
+            body = f"<unrealized {self._data}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element NDArray")
+        return bool(self.asnumpy().item())
+
+    # ------------------------------------------------------------------
+    # mutation (buffer swap)
+    # ------------------------------------------------------------------
+    def _set_data(self, new_data) -> None:
+        self._data = new_data
+        self._version += 1
+
+    # ------------------------------------------------------------------
+    # host transfer / sync
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        """Blocking copy to host (reference: NDArray.asnumpy ~L2000)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def wait_to_read(self) -> None:
+        data = self._data
+        if hasattr(data, "block_until_ready"):
+            data.block_until_ready()
+
+    def wait_to_write(self) -> None:
+        self.wait_to_read()
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        tgt = dtype_np(dtype)
+        if not copy and np.dtype(self._data.dtype) == tgt:
+            return self
+        return _reg.invoke_fn(lambda x: x.astype(tgt), [self])
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, ctx=self._ctx)
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        import jax
+
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
+        other._set_data(jax.device_put(self._data, other.context.jax_device))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        import jax
+
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype: str):
+        if stype != "default":
+            raise MXNetError(
+                "sparse storage types are emulated at the frontend; see "
+                "mxnet_tpu.ndarray.sparse"
+            )
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd hooks
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype: Optional[str] = None) -> None:
+        from .. import autograd
+
+        jnp = _jnp()
+        self._grad = NDArray(jnp.zeros_like(self._data), ctx=self._ctx)
+        self._grad_req = grad_req
+        autograd.register_leaf(self)
+
+    def detach(self) -> "NDArray":
+        """Return an array sharing this buffer but excluded from gradient
+        flow.  Zero-copy: ops.registry applies stop_gradient at use-site."""
+        out = NDArray(self._data, ctx=self._ctx)
+        out._detached = True
+        return out
+
+    def backward(self, out_grad=None, retain_graph: bool = False,
+                 train_mode: bool = True) -> None:
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _convert_key(key):
+        if isinstance(key, NDArray):
+            return key._data.astype("int32")
+        if isinstance(key, tuple):
+            return tuple(
+                k._data.astype("int32") if isinstance(k, NDArray) else k for k in key
+            )
+        return key
+
+    def __getitem__(self, key) -> "NDArray":
+        k = self._convert_key(key)
+        return _reg.invoke_fn(lambda x: x[k], [self])
+
+    def __setitem__(self, key, value) -> None:
+        k = self._convert_key(key)
+        jnp = _jnp()
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (np.ndarray, list, tuple, int, float)):
+            v = jnp.asarray(value, dtype=self._data.dtype)
+        else:
+            v = value
+        if isinstance(k, slice) and k == slice(None):
+            new = jnp.broadcast_to(
+                jnp.asarray(v, dtype=self._data.dtype), self.shape
+            )
+        else:
+            new = self._data.at[k].set(v)
+        self._set_data(new)
+
+    # ------------------------------------------------------------------
+    # arithmetic sugar (reference: broadcast_* dispatch in ndarray.py)
+    # ------------------------------------------------------------------
+    def _binary(self, other, opname, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _reg.invoke_by_name(opname, [a, b])
+        if isinstance(other, (int, float, bool, np.generic)):
+            jnp = _jnp()
+            scalar = NDArray(
+                jnp.asarray(other, dtype=self._data.dtype), ctx=self._ctx
+            )
+            a, b = (scalar, self) if reverse else (self, scalar)
+            return _reg.invoke_by_name(opname, [a, b])
+        return NotImplemented
+
+    def __add__(self, other):
+        return self._binary(other, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "broadcast_sub")
+
+    def __rsub__(self, other):
+        return self._binary(other, "broadcast_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "broadcast_div")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "broadcast_div", reverse=True)
+
+    def __mod__(self, other):
+        return self._binary(other, "broadcast_mod")
+
+    def __rmod__(self, other):
+        return self._binary(other, "broadcast_mod", reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, "broadcast_power")
+
+    def __rpow__(self, other):
+        return self._binary(other, "broadcast_power", reverse=True)
+
+    def __neg__(self):
+        return _reg.invoke_by_name("negative", [self])
+
+    def __abs__(self):
+        return _reg.invoke_by_name("abs", [self])
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binary(other, "broadcast_equal")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binary(other, "broadcast_not_equal")
+
+    def __gt__(self, other):
+        return self._binary(other, "broadcast_greater")
+
+    def __ge__(self, other):
+        return self._binary(other, "broadcast_greater_equal")
+
+    def __lt__(self, other):
+        return self._binary(other, "broadcast_lesser")
+
+    def __le__(self, other):
+        return self._binary(other, "broadcast_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: buffer swap
+    def _inplace(self, other, opname):
+        res = self._binary(other, opname)
+        if res is NotImplemented:
+            return res
+        self._set_data(res._data)
+        return self
+
+    def __iadd__(self, other):
+        return self._inplace(other, "broadcast_add")
+
+    def __isub__(self, other):
+        return self._inplace(other, "broadcast_sub")
+
+    def __imul__(self, other):
+        return self._inplace(other, "broadcast_mul")
+
+    def __itruediv__(self, other):
+        return self._inplace(other, "broadcast_div")
+
+    # ------------------------------------------------------------------
+    # op methods: any registered op name is available as a method with
+    # `self` as first input (parity with MXNet's autogenerated methods).
+    # ------------------------------------------------------------------
+    def __getattr__(self, name):
+        # Resolve through the nd-namespace stubs so positional attrs map to
+        # op kwargs identically whether called as nd.op(x, ...) or x.op(...).
+        import sys
+
+        stub = sys.modules[__package__].__dict__.get(name)
+        if stub is None or not callable(stub):
+            raise AttributeError(
+                f"'NDArray' object has no attribute {name!r}"
+            )
+        nd = self
+
+        def method(*args, **kwargs):
+            return stub(nd, *args, **kwargs)
+
+        method.__name__ = name
+        return method
+
+    # explicit common methods (avoid __getattr__ for the hot ones and for
+    # those whose python-level signature differs from the raw op)
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _reg.invoke_by_name("reshape", [self], shape=tuple(shape),
+                                   reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _reg.invoke_fn(lambda x, y: x.reshape(y.shape), [self, other])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _reg.invoke_by_name("transpose", [self], axes=tuple(axes))
+
+    def flatten(self):
+        return _reg.invoke_by_name("Flatten", [self])
+
+    def expand_dims(self, axis):
+        return _reg.invoke_by_name("expand_dims", [self], axis=axis)
+
+    def squeeze(self, axis=None):
+        return _reg.invoke_by_name("squeeze", [self], axis=axis)
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _reg.invoke_by_name("sum", [self], axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _reg.invoke_by_name("mean", [self], axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return _reg.invoke_by_name("max", [self], axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return _reg.invoke_by_name("min", [self], axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _reg.invoke_by_name("argmax", [self], axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _reg.invoke_by_name("argmin", [self], axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min=None, a_max=None):
+        return _reg.invoke_by_name("clip", [self], a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return _reg.invoke_by_name("abs", [self])
+
+    def slice_axis(self, axis, begin, end):
+        return _reg.invoke_by_name("slice_axis", [self], axis=axis, begin=begin,
+                                   end=end)
+
+    def zeros_like(self):
+        return _reg.invoke_by_name("zeros_like", [self])
+
+    def ones_like(self):
+        return _reg.invoke_by_name("ones_like", [self])
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from array-like (reference: mx.nd.array)."""
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(source, NDArray):
+        src = source.asnumpy()
+    else:
+        src = np.asarray(source)
+    if dtype is None:
+        dtype = np.float32 if src.dtype == np.float64 else src.dtype
+    src = src.astype(dtype_np(dtype), copy=False)
+    return NDArray(jax.device_put(src, ctx.jax_device), ctx=ctx)
+
+
+def from_jax(arr, ctx: Optional[Context] = None) -> NDArray:
+    return NDArray(arr, ctx=ctx)

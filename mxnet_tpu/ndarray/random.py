@@ -1,0 +1,130 @@
+"""``mx.nd.random`` namespace (reference: python/mxnet/ndarray/random.py).
+
+Each function injects the next key from the stateful facade in
+mxnet_tpu.random and dispatches to the pure keyed ops in ops/random_ops.py.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..base import dtype_np
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle"]
+
+
+def _key_nd(ctx: Optional[Context]):
+    from .. import random as _rng
+    from .ndarray import NDArray
+
+    ctx = ctx or current_context()
+    return NDArray(_rng.next_key(), ctx=ctx), ctx
+
+
+def _dtname(dtype, default="float32"):
+    if dtype is None:
+        return default
+    return np.dtype(dtype_np(dtype)).name
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    from .ndarray import NDArray
+
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        from . import array
+
+        low = low if isinstance(low, NDArray) else array(low, ctx=high.context)
+        high = high if isinstance(high, NDArray) else array(high, ctx=low.context)
+        key, _ = _key_nd(ctx or low.context)
+        return _reg.invoke_by_name("sample_uniform", [key, low, high], out=out,
+                                   shape=_shape(shape), dtype=_dtname(dtype))
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_uniform", [key], out=out, low=low,
+                               high=high, shape=_shape(shape),
+                               dtype=_dtname(dtype))
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    from .ndarray import NDArray
+
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        from . import array
+
+        loc = loc if isinstance(loc, NDArray) else array(loc, ctx=scale.context)
+        scale = scale if isinstance(scale, NDArray) else array(scale, ctx=loc.context)
+        key, _ = _key_nd(ctx or loc.context)
+        return _reg.invoke_by_name("sample_normal", [key, loc, scale], out=out,
+                                   shape=_shape(shape), dtype=_dtname(dtype))
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_normal", [key], out=out, loc=loc,
+                               scale=scale, shape=_shape(shape),
+                               dtype=_dtname(dtype))
+
+
+def randn(*shape, dtype=None, ctx=None, loc=0.0, scale=1.0, **kw):
+    return normal(loc=loc, scale=scale, shape=shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(), dtype=None, ctx=None, out=None, **kw):
+    if high is None:
+        low, high = 0, low
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_randint", [key], out=out, low=int(low),
+                               high=int(high), shape=_shape(shape),
+                               dtype=_dtname(dtype, "int32"))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_gamma", [key], out=out, alpha=alpha,
+                               beta=beta, shape=_shape(shape),
+                               dtype=_dtname(dtype))
+
+
+def exponential(lam=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_exponential", [key], out=out, lam=lam,
+                               shape=_shape(shape), dtype=_dtname(dtype))
+
+
+def poisson(lam=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_poisson", [key], out=out, lam=lam,
+                               shape=_shape(shape), dtype=_dtname(dtype))
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype=None, ctx=None, out=None, **kw):
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_negative_binomial", [key], out=out, k=k,
+                               p=p, shape=_shape(shape), dtype=_dtname(dtype))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype=None,
+                                  ctx=None, out=None, **kw):
+    key, _ = _key_nd(ctx)
+    return _reg.invoke_by_name("_random_generalized_negative_binomial", [key],
+                               out=out, mu=mu, alpha=alpha, shape=_shape(shape),
+                               dtype=_dtname(dtype))
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    key, _ = _key_nd(data.context)
+    return _reg.invoke_by_name("_sample_multinomial", [key, data],
+                               shape=_shape(shape) or (1,), get_prob=get_prob,
+                               dtype=_dtname(dtype, "int32"))
+
+
+def shuffle(data, **kw):
+    key, _ = _key_nd(data.context)
+    return _reg.invoke_by_name("_shuffle", [key, data])

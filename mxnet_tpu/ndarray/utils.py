@@ -1,0 +1,91 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Reference parity: src/ndarray/ndarray.cc NDArray::Save/Load (~L1500) and
+python mx.nd.save/load — a single file holding either a list of arrays or a
+str->array map.  We use our own container format (the reference's binary
+layout embeds mshadow TBlob internals that have no meaning here):
+
+    magic 'MXTPND01' | u64 header_len | header JSON | raw little-endian buffers
+
+bfloat16 is stored as raw uint16 payload with dtype recorded in the header.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Union
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+
+_MAGIC = b"MXTPND01"
+
+
+def _to_bytes(arr: np.ndarray):
+    dtype = np.dtype(arr.dtype)
+    name = dtype.name if dtype.kind != "V" else "bfloat16"
+    if name == "bfloat16":
+        raw = arr.view(np.uint16)
+        return name, raw.tobytes()
+    return name, np.ascontiguousarray(arr).tobytes()
+
+
+def _from_bytes(buf: bytes, dtype_name: str, shape):
+    if dtype_name == "bfloat16":
+        arr = np.frombuffer(buf, dtype=np.uint16).reshape(shape)
+        return arr.view(dtype_np("bfloat16"))
+    return np.frombuffer(buf, dtype=np.dtype(dtype_name)).reshape(shape)
+
+
+def save(fname: str, data) -> None:
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        names = [str(i) for i in range(len(data))]
+        arrays = list(data)
+        keyed = False
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+        keyed = True
+    else:
+        raise MXNetError("save expects NDArray, list, or dict of NDArrays")
+
+    entries = []
+    payloads = []
+    for name, nd in zip(names, arrays):
+        arr = nd.asnumpy()
+        dtname, raw = _to_bytes(arr)
+        entries.append({"name": name, "dtype": dtname, "shape": list(arr.shape),
+                        "nbytes": len(raw)})
+        payloads.append(raw)
+    header = json.dumps({"keyed": keyed, "entries": entries}).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for p in payloads:
+            f.write(p)
+
+
+def load(fname: str):
+    from . import array
+    from .ndarray import NDArray
+
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not an mxnet_tpu NDArray file")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        out = []
+        for e in header["entries"]:
+            raw = f.read(e["nbytes"])
+            np_arr = _from_bytes(raw, e["dtype"], tuple(e["shape"]))
+            out.append((e["name"], array(np_arr, dtype=np_arr.dtype)))
+    if header["keyed"]:
+        return dict(out)
+    return [nd for _, nd in out]

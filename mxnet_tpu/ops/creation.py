@@ -1,0 +1,50 @@
+"""Array creation ops (reference: src/operator/tensor/init_op.*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+@register("_zeros", differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype_np(dtype))
+
+
+@register("_ones", differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype_np(dtype))
+
+
+@register("_full", differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, dtype_np(dtype))
+
+
+@register("_arange", differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    arr = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat != 1:
+        arr = jnp.repeat(arr, repeat)
+    return arr
+
+
+@register("_eye", differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(N, M if M else None, k=k, dtype=dtype_np(dtype))
+
+
+@register("zeros_like", differentiable=False)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", differentiable=False)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("_linspace", differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype_np(dtype))

@@ -1,0 +1,219 @@
+"""Elementwise unary/binary/scalar ops.
+
+Reference parity: src/operator/tensor/elemwise_unary_op_basic.*,
+elemwise_binary_op_basic.*, elemwise_binary_broadcast_op_*,
+src/operator/mshadow_op.h (~200 scalar functors).  On TPU these all lower to
+single fused XLA HLO ops on the VPU; no hand-written kernels are needed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "square": jnp.square,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": jax.lax.lgamma,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name)(lambda x, _f=_f: _f(x))
+
+register("identity")(lambda x: x)
+register("_copy")(lambda x: x)
+register("stop_gradient", differentiable=False)(lambda x: jax.lax.stop_gradient(x))
+register("BlockGrad", differentiable=False)(lambda x: jax.lax.stop_gradient(x))
+register("make_loss")(lambda x: x)
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("add_n")
+def add_n(*args, num_args=None):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("ElementWiseSum")
+def element_wise_sum(*args, num_args=None):
+    return add_n(*args)
+
+
+@register("Cast", differentiable=False)
+def cast(x, dtype="float32"):
+    from ..base import dtype_np
+
+    return x.astype(dtype_np(dtype))
+
+
+# ---------------------------------------------------------------------------
+# binary (broadcasting; MXNet's elemwise_* are the same math with shapes equal)
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+for _name, _f in _BINARY.items():
+    register("broadcast_" + _name)(lambda a, b, _f=_f: _f(a, b))
+
+register("elemwise_add")(lambda a, b: jnp.add(a, b))
+register("elemwise_sub")(lambda a, b: jnp.subtract(a, b))
+register("elemwise_mul")(lambda a, b: jnp.multiply(a, b))
+register("elemwise_div")(lambda a, b: jnp.divide(a, b))
+register("broadcast_plus")(lambda a, b: jnp.add(a, b))
+register("broadcast_minus")(lambda a, b: jnp.subtract(a, b))
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+for _name, _f in _CMP.items():
+    # MXNet comparison ops return the input dtype (1.0/0.0), not bool.
+    register("broadcast_" + _name, differentiable=False)(
+        lambda a, b, _f=_f: _f(a, b).astype(a.dtype)
+    )
+
+
+# ---------------------------------------------------------------------------
+# scalar ops (back the NDArray operator sugar; reference:
+# src/operator/tensor/elemwise_binary_scalar_op_*)
+# ---------------------------------------------------------------------------
+@register("_plus_scalar")
+def _plus_scalar(x, scalar=0.0):
+    return x + scalar
+
+
+@register("_minus_scalar")
+def _minus_scalar(x, scalar=0.0):
+    return x - scalar
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(x, scalar=0.0):
+    return scalar - x
+
+
+@register("_mul_scalar")
+def _mul_scalar(x, scalar=1.0):
+    return x * scalar
+
+
+@register("_div_scalar")
+def _div_scalar(x, scalar=1.0):
+    return x / scalar
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(x, scalar=1.0):
+    return scalar / x
+
+
+@register("_mod_scalar")
+def _mod_scalar(x, scalar=1.0):
+    return jnp.mod(x, scalar)
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(x, scalar=1.0):
+    return jnp.mod(scalar, x)
+
+
+@register("_power_scalar")
+def _power_scalar(x, scalar=1.0):
+    return jnp.power(x, scalar)
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(x, scalar=1.0):
+    return jnp.power(scalar, x)
+
+
+@register("_maximum_scalar")
+def _maximum_scalar(x, scalar=0.0):
+    return jnp.maximum(x, scalar)
+
+
+@register("_minimum_scalar")
+def _minimum_scalar(x, scalar=0.0):
+    return jnp.minimum(x, scalar)
+
+
+for _name, _f in _CMP.items():
+    register(f"_{_name}_scalar", differentiable=False)(
+        lambda x, scalar=0.0, _f=_f: _f(x, scalar).astype(x.dtype)
+    )
+
+
+@register("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(
+        jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x), jnp.abs(x) - 0.5 / s2
+    )

@@ -1,0 +1,136 @@
+"""Indexing ops (reference: src/operator/tensor/indexing_op.* — take,
+gather_nd, scatter_nd, one_hot, Embedding fwd/bwd)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np
+from .registry import register
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("pick")
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+
+
+@register("gather_nd")
+def gather_nd(a, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return a[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, rhs, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype_np(dtype)) * (
+        on_value - off_value
+    ) + off_value
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Embedding lookup; gradient flows to `weight` via the vjp of take —
+    XLA emits a scatter-add, the dense analog of the reference's
+    row_sparse embedding backward (indexing_op.h EmbeddingOpBackward)."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # broadcast steps along `axis` against batch on the other time/batch axis
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)
+        shape = mask.shape + (1,) * (data.ndim - 2)
+        mask = mask.reshape(shape)
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(steps.dtype)
+        shape = mask.shape + (1,) * (data.ndim - 2)
+        mask = mask.reshape(shape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    dm = jnp.moveaxis(data, axis, 0)
+    return jax.vmap(lambda t, i: t[i], in_axes=(1, 0))(dm, last)
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    dm = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = dm.shape[0]
+    steps = jnp.arange(T)
+
+    def rev_one(col, length):
+        idx = jnp.where(steps < length, length - 1 - steps, steps)
+        return col[idx]
+
+    out = jax.vmap(rev_one, in_axes=(1, 0), out_axes=1)(dm, sequence_length.astype(jnp.int32))
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("boolean_mask", differentiable=False)
+def boolean_mask(data, index, axis=0):
+    # Dynamic-shape op: XLA needs static shapes, so we compact valid rows to
+    # the front and return a full-size array (documented divergence; the
+    # masked count is data.shape[axis] with invalid rows zeroed).
+    mask = index != 0
+    order = jnp.argsort(~mask, stable=True)
+    gathered = jnp.take(data, order, axis=axis)
+    keep = jnp.sort(mask)[::-1]
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return gathered * keep.reshape(shape).astype(data.dtype)
+
+
+@register("_ravel_multi_index", differentiable=False)
+def _ravel_multi_index(indices, shape=()):
+    idx = indices.astype(jnp.int64)
+    strides = np.concatenate([np.cumprod(np.asarray(shape)[::-1])[::-1][1:], [1]])
+    return jnp.sum(idx * strides[:, None], axis=0).astype(jnp.int64)
+
+
+@register("_unravel_index", differentiable=False)
+def _unravel_index(indices, shape=()):
+    out = jnp.stack(jnp.unravel_index(indices.astype(jnp.int64), shape))
+    return out.astype(jnp.int64)

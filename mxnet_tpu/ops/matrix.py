@@ -1,0 +1,303 @@
+"""Shape-manipulation and linear-algebra ops.
+
+Reference parity: src/operator/tensor/matrix_op.* (transpose/reshape/slice/
+concat/tile/... ~L1-3000), dot.{cc,cu} (GEMM dispatch to cuBLAS/rocBLAS).
+On TPU `dot`/`batch_dot` lower straight onto the MXU via lax.dot_general.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+
+@register("reshape")
+def reshape(x, shape=None, reverse=False):
+    """MXNet reshape with special codes 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split) — reference matrix_op-inl.h InferReshapeShape."""
+    if shape is None:
+        return x
+    src = list(x.shape)
+    if reverse:
+        src = src[::-1]
+        shape = list(shape)[::-1]
+    out = []
+    i = 0  # index into src
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    if out.count(-1) == 1:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        out[out.index(-1)] = int(np.prod(x.shape)) // known
+    return jnp.reshape(x, tuple(out))
+
+
+@register("Reshape")
+def Reshape(x, shape=None, reverse=False):
+    return reshape(x, shape=shape, reverse=reverse)
+
+
+@register("Flatten")
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def transpose(x, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis if axis is None else tuple(np.atleast_1d(axis)))
+
+
+@register("swapaxes")
+def swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("SwapAxis")
+def SwapAxis(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("flip")
+def flip(x, axis=0):
+    return jnp.flip(x, axis)
+
+
+@register("reverse")
+def reverse(x, axis=0):
+    return jnp.flip(x, axis)
+
+
+@register("tile")
+def tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=()):
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(x, y, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, y.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = y.shape[ra]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_axis")
+def broadcast_axis(x, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("Concat")
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register("split")
+def split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("SliceChannel")
+def SliceChannel(x, num_outputs=1, axis=1, squeeze_axis=False):
+    return split(x, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
+
+
+@register("slice")
+def slice_op(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(x, shape_like, axes=()):
+    axes = axes or tuple(range(min(x.ndim, shape_like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("pad")
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError(f"pad mode {mode}")
+
+
+@register("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts last axis of a with first axis of b (reference
+    src/operator/tensor/dot-inl.h); rides the MXU via dot_general."""
+    if transpose_a:
+        a = jnp.transpose(a, tuple(range(1, a.ndim)) + (0,)) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.transpose(b, (b.ndim - 1,) + tuple(range(0, b.ndim - 1))) if b.ndim > 1 else b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-3):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_syrk")
+def linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_potrf")
+def linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_trsm")
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        lower = not lower
+    out = jax.scipy.linalg.solve_triangular(
+        a, b if not rightside else jnp.swapaxes(b, -1, -2), lower=lower
+    )
+    if rightside:
+        out = jnp.swapaxes(out, -1, -2)
+    return alpha * out
+
+
+@register("where")
+def where(cond, a, b):
+    return jnp.where(cond != 0, a, b)
+
+
+@register("depth_to_space")
+def depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(n, bs, bs, c // (bs * bs), h, w)
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return y.reshape(n, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return y.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register("diag")
+def diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.asarray(x.shape, dtype=np.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(x):
+    return jnp.asarray([x.size], dtype=np.int64)
+
+
+@register("zeros_like_legacy", differentiable=False)
+def zeros_like_legacy(x):
+    return jnp.zeros_like(x)

@@ -1,0 +1,438 @@
+"""Neural-network layer ops.
+
+Reference parity: src/operator/nn/ (convolution, fully_connected, batch_norm,
+pooling, activation, softmax, dropout, layer_norm, lrn, upsampling ...) and
+the cuDNN/MIOpen wrapper family.  On TPU the vendor-library role is played by
+XLA itself: conv/matmul lower onto the MXU (lax.conv_general_dilated /
+dot_general), normalizations and activations fuse into neighbouring HLO.
+All spatial ops use MXNet's native NC[DHW] layouts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _pair(v, n):
+    if v is None or v == ():
+        return (0,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# dense / conv
+# ---------------------------------------------------------------------------
+@register("FullyConnected")
+def fully_connected(data, weight, *bias, num_hidden=None, no_bias=False, flatten=True):
+    """y = x W^T + b (reference: src/operator/nn/fully_connected-inl.h).
+
+    Weight layout (num_hidden, input_dim), matching MXNet exactly.
+    """
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    # contract input_dim; keep bf16 inputs on the MXU with f32 accumulation
+    y = jax.lax.dot_general(
+        x, weight,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if not no_bias and bias:
+        y = y + bias[0]
+    return y
+
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+@register("Convolution")
+def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
+                num_filter=1, num_group=1, no_bias=False, workspace=1024,
+                cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-d convolution, OIHW weights (reference src/operator/nn/convolution-inl.h).
+
+    cudnn_* attrs are accepted and ignored: algorithm selection is XLA's job.
+    """
+    n = _conv_dims(kernel)
+    stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
+    pad = _pair(pad, n)
+    spatial = "DHW"[-n:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial),
+    )
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not no_bias and bias:
+        b = bias[0].reshape((1, -1) + (1,) * n)
+        out = out + b
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
+                  adj=(), target_shape=(), num_filter=1, num_group=1,
+                  no_bias=True, workspace=1024, cudnn_tune=None, cudnn_off=False,
+                  layout=None):
+    """Transposed convolution (reference src/operator/nn/deconvolution-inl.h).
+    Weight layout (C_in, C_out/group, *kernel) as in MXNet."""
+    n = _conv_dims(kernel)
+    stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
+    pad = _pair(pad, n)
+    adj = _pair(adj, n) if adj else (0,) * n
+    spatial = "DHW"[-n:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial),
+    )
+    # lhs_dilation implements the fractional stride; padding chosen so that
+    # out = (in-1)*s - 2p + dilate*(k-1) + 1 + adj  (MXNet's formula)
+    pads = []
+    for i in range(n):
+        k = dilate[i] * (kernel[i] - 1) + 1
+        lo = k - 1 - pad[i]
+        hi = k - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=(1,) * n,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not no_bias and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+@register("Pooling")
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", count_include_pad=True,
+            cudnn_off=False, p_value=2, layout=None):
+    """Spatial pooling (reference src/operator/nn/pooling-inl.h)."""
+    n = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * n
+        pad = (0,) * n
+    kernel = _pair(kernel, n)
+    stride = _pair(stride or 1, n)
+    pad = _pair(pad, n)
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad on the high side enough to cover the last window
+        extra = []
+        for i in range(n):
+            in_i = data.shape[2 + i]
+            out_i = int(np.ceil((in_i + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            need = (out_i - 1) * stride[i] + kernel[i] - in_i - pad[i]
+            extra.append(max(need, pad[i]))
+        pads = ((0, 0), (0, 0)) + tuple((pad[i], extra[i]) for i in range(n))
+    else:
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    if pool_type == "max":
+        init = -jnp.inf if data.dtype.kind == "f" else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = float(np.prod(kernel))
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        powed = jax.lax.reduce_window(
+            jnp.abs(data) ** p_value, 0.0, jax.lax.add, window, strides, pads
+        )
+        return powed ** (1.0 / p_value)
+    raise MXNetError(f"pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+@register("Activation")
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise MXNetError(f"act_type {act_type}")
+
+
+@register("LeakyReLU")
+def leaky_relu(data, *gamma, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "prelu":
+        g = gamma[0]
+        shape = [1] * data.ndim
+        if data.ndim > 1:
+            shape[1] = g.size
+        return jnp.where(data >= 0, data, g.reshape(shape) * data)
+    if act_type == "rrelu":
+        # deterministic mid-slope outside training (reference uses RNG in train)
+        mid = (lower_bound + upper_bound) / 2
+        return jnp.where(data >= 0, data, mid * data)
+    raise MXNetError(f"act_type {act_type}")
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+@register("softmax")
+def softmax(data, *length, axis=-1, temperature=None, dtype=None,
+            use_length=False):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=logp.dtype)
+    return jnp.sum(-logp * onehot)
+
+
+_softmax_output_cache = {}
+
+
+def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output,
+                         normalization, smooth_alpha):
+    """Build a custom_vjp softmax-output closed over its (static) attrs.
+
+    Legacy semantics: backward IGNORES the incoming cotangent and emits
+    (p - onehot(label)) scaled — reference src/operator/nn/softmax_output-inl.h.
+    """
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def fwd(data, label):
+        return jax.nn.softmax(data, axis=axis)
+
+    def f(data, label):
+        out = jax.nn.softmax(data, axis=axis)
+        return out, (out, label)
+
+    def b(res, g):
+        out, label = res
+        k = out.shape[axis]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), k, dtype=out.dtype)
+        if multi_output:
+            onehot = jnp.moveaxis(onehot, -1, 1)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - onehot)
+        grad = out - onehot
+        if use_ignore:
+            mask = (label != ignore_label).astype(out.dtype)
+            if mask.ndim < grad.ndim:
+                mask = jnp.expand_dims(mask, axis)
+            grad = grad * mask
+        scale = grad_scale
+        if normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(label != ignore_label).astype(out.dtype), 1.0)
+            scale = grad_scale / valid
+        elif normalization == "batch":
+            scale = grad_scale / out.shape[0]
+        if label.dtype.kind == "f":
+            lab_ct = jnp.zeros_like(label)
+        else:  # integer labels: jax requires a float0 cotangent
+            lab_ct = np.zeros(label.shape, dtype=jax.dtypes.float0)
+        return (grad * scale, lab_ct)
+
+    fwd.defvjp(f, b)
+    return fwd
+
+
+@register("SoftmaxOutput")
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   use_ignore=False, multi_output=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    key = (grad_scale, ignore_label, use_ignore, multi_output, normalization,
+           smooth_alpha)
+    fn = _softmax_output_cache.get(key)
+    if fn is None:
+        fn = _make_softmax_output(*key)
+        _softmax_output_cache[key] = fn
+    return fn(data, label)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register("BatchNorm")
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, training=False):
+    """BatchNorm (reference src/operator/nn/batch_norm-inl.h).
+
+    Pure function: in training mode returns (out, batch_mean, batch_var) when
+    output_mean_var so the caller (gluon.nn.BatchNorm) can update the moving
+    aux states — the reference mutates aux in-op; we keep the op pure for XLA.
+    `training` comes from autograd train-mode, threaded by the caller.
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    if training and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.mean(jnp.square(x32 - mean.reshape(shape)), axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps).astype(data.dtype)
+    out = (data - mean.reshape(shape).astype(data.dtype)) * inv * g.reshape(
+        shape
+    ).astype(data.dtype) + beta.reshape(shape).astype(data.dtype)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = ((x32 - mean) * inv).astype(data.dtype) * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    spatial = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + spatial)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = jnp.stack(
+        [padded[:, i : i + data.shape[1]] for i in range(nsize)], axis=0
+    ).sum(axis=0)
+    return data / jnp.power(knorm + alpha / nsize * window, beta)
+
+
+# ---------------------------------------------------------------------------
+# dropout (RNG key threaded explicitly; see mxnet_tpu.random)
+# ---------------------------------------------------------------------------
+@register("Dropout", differentiable=True)
+def dropout(data, key, p=0.5, mode="training", axes=(), training=False,
+            cudnn_off=False):
+    if not training or p <= 0.0:
+        return data
+    # `axes` = variational dropout: the mask is broadcast along those axes
+    shape = [1 if i in axes else data.shape[i] for i in range(data.ndim)]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# resize
+# ---------------------------------------------------------------------------
+@register("UpSampling")
+def upsampling(*inputs, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    data = inputs[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+@register("BilinearResize2D")
+def bilinear_resize_2d(data, height=1, width=1, scale_height=None, scale_width=None,
+                       mode="size"):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height, width = int(h * scale_height), int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), method="bilinear")

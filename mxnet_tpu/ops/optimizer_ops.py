@@ -1,0 +1,198 @@
+"""Fused optimizer update ops.
+
+Reference parity: src/operator/optimizer_op.{cc,cu,-inl.h} — sgd_update,
+sgd_mom_update, mp_sgd_* (fp16 weights + fp32 master copy), adam_update,
+lamb_update_phase1/2, ftrl_update, signsgd/signum, multi-tensor variants.
+Each is a single jitted XLA computation; XLA fuses the whole update chain
+into one pass over the parameter, same as the reference's fused kernels.
+All ops are non-differentiable state transitions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update", differentiable=False)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", differentiable=False)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_mom = momentum * mom.astype(jnp.float32) - lr * g
+    new_w = weight.astype(jnp.float32) + new_mom
+    return new_w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("mp_sgd_update", differentiable=False)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(grad, weight32, rescale_grad, wd, clip_gradient)
+    new32 = weight32 - lr * g
+    return new32.astype(weight.dtype), new32
+
+
+@register("mp_sgd_mom_update", differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _apply_wd_rescale(grad, weight32, rescale_grad, wd, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    new32 = weight32 + new_mom
+    return new32.astype(weight.dtype), new_mom, new32
+
+
+@register("nag_mom_update", differentiable=False)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_mom = momentum * mom.astype(jnp.float32) + g
+    new_w = weight.astype(jnp.float32) - lr * (g + momentum * new_mom)
+    return new_w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("adam_update", differentiable=False)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+@register("mp_adam_update", differentiable=False)
+def mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
+                   beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight32, rescale_grad, wd, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new32 = weight32 - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new32.astype(weight.dtype), new_mean, new_var, new32
+
+
+@register("ftrl_update", differentiable=False)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight.astype(jnp.float32)
+    denom = (beta + jnp.sqrt(new_n)) / lr + wd
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) / denom,
+        0.0,
+    )
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, 0.0, clip_gradient)
+    new_w = weight.astype(jnp.float32) * (1 - lr * wd) - lr * jnp.sign(g)
+    return new_w.astype(weight.dtype)
+
+
+@register("signum_update", differentiable=False)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    new_w = weight.astype(jnp.float32) * (1 - lr * wd_lh) + lr * jnp.sign(new_mom)
+    return new_w.astype(weight.dtype), new_mom
+
+
+@register("rmsprop_update", differentiable=False)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n
+
+
+@register("rmspropalex_update", differentiable=False)
+def rmspropalex_update(weight, grad, n, g_buf, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_buf + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight.astype(jnp.float32) + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+@register("adagrad_update", differentiable=False)
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_hist = history + jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_hist) + epsilon)
+    return new_w.astype(weight.dtype), new_hist
+
+
+@register("adadelta_update", differentiable=False)
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, wd, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    new_w = weight.astype(jnp.float32) - delta
+    return new_w.astype(weight.dtype), new_acc_g, new_acc_delta
+
+
+@register("lamb_update_phase1", differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1**t)
+        v = v / (1 - beta2**t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight.astype(jnp.float32)
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2", differentiable=False)
+def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    new_w = weight.astype(jnp.float32) - lr * ratio * g_update
+    return new_w.astype(weight.dtype)

@@ -1,0 +1,109 @@
+"""Random sampling ops.
+
+Reference parity: src/operator/random/sample_op.* over per-device Philox
+streams (include/mxnet/random_generator.h ~L100).  TPU-native: jax's
+counter-based threefry/rbg keys — the stateful MXNet seed facade lives in
+mxnet_tpu.random, which threads an explicit key into every op here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+@register("_random_uniform", differentiable=False)
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(
+        key, shape, dtype_np(dtype), minval=low, maxval=high
+    )
+
+
+@register("_random_normal", differentiable=False)
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, shape, dtype_np(dtype))
+
+
+@register("_random_gamma", differentiable=False)
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(key, alpha, shape, dtype_np(dtype))
+
+
+@register("_random_exponential", differentiable=False)
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, shape, dtype_np(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False)
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, shape).astype(dtype_np(dtype))
+
+
+@register("_random_randint", differentiable=False)
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, shape, low, high, dtype_np(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False)
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * (1 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial", differentiable=False)
+def _random_generalized_negative_binomial(key, mu=1.0, alpha=1.0, shape=(),
+                                          dtype="float32"):
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(kg, r, shape) * (1 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(dtype_np(dtype))
+
+
+@register("_sample_multinomial", differentiable=False)
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    n = int(shape[0]) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(n,) + data.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype(jnp.int32)[..., None] if data.ndim > 1 else out.astype(jnp.int32),
+            axis=-1,
+        )
+        return out, logp.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", differentiable=False)
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("sample_uniform", differentiable=False)
+def sample_uniform(key, low, high, shape=(), dtype="float32"):
+    s = tuple(shape) if shape else ()
+    out_shape = low.shape + s
+    u = jax.random.uniform(key, out_shape, dtype_np(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register("sample_normal", differentiable=False)
+def sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    s = tuple(shape) if shape else ()
+    out_shape = mu.shape + s
+    z = jax.random.normal(key, out_shape, dtype_np(dtype))
+    mu_b = mu.reshape(mu.shape + (1,) * len(s))
+    sigma_b = sigma.reshape(sigma.shape + (1,) * len(s))
+    return mu_b + z * sigma_b

@@ -1,0 +1,138 @@
+"""Reduction and ordering ops.
+
+Reference parity: src/operator/tensor/broadcast_reduce_op_value.* (sum, mean,
+norm, ...), ordering_op.* (topk/sort/argsort via CUB→hipCUB).  XLA lowers
+reductions to tiled VPU code; sorting uses XLA's variadic sort.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _axis(axis):
+    """MXNet axis attr: None/int/tuple; () means all axes in 1.x."""
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(fn, x, axis=None, keepdims=False, exclude=False):
+    ax = _axis(axis)
+    if exclude and ax is not None:
+        if isinstance(ax, int):
+            ax = (ax,)
+        ax = tuple(i for i in range(x.ndim) if i not in tuple(a % x.ndim for a in ax))
+    return fn(x, axis=ax, keepdims=keepdims)
+
+
+for _name, _f in {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+    "max": jnp.max,
+    "min": jnp.min,
+}.items():
+    register(_name)(
+        lambda x, axis=None, keepdims=False, exclude=False, _f=_f: _reduce(
+            _f, x, axis, keepdims, exclude
+        )
+    )
+
+register("sum_axis")(lambda x, axis=None, keepdims=False: _reduce(jnp.sum, x, axis, keepdims))
+register("max_axis")(lambda x, axis=None, keepdims=False: _reduce(jnp.max, x, axis, keepdims))
+register("min_axis")(lambda x, axis=None, keepdims=False: _reduce(jnp.min, x, axis, keepdims))
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    ax = _axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def argmax(x, axis=None, keepdims=False):
+    ax = _axis(axis)
+    out = jnp.argmax(x, axis=ax)
+    if keepdims and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return out.astype(np.float32)  # MXNet returns float indices
+
+
+@register("argmin", differentiable=False)
+def argmin(x, axis=None, keepdims=False):
+    ax = _axis(axis)
+    out = jnp.argmin(x, axis=ax)
+    if keepdims and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return out.astype(np.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(np.float32)
+
+
+@register("topk", differentiable=False)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_np
+
+    if axis is None:
+        # MXNet: axis=None selects the global top-k over the flattened array
+        xm = x.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+        xm = jnp.moveaxis(x, ax, -1)
+    if is_ascend:
+        v, idx = jax.lax.top_k(-xm, k)
+        v = -v
+    else:
+        v, idx = jax.lax.top_k(xm, k)
+    v = jnp.moveaxis(v, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(dtype_np(dtype))
+    if ret_typ == "value":
+        return v
+    if ret_typ == "both":
+        return (v, idx)
+    return idx
+
+
+@register("sort", differentiable=False)
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(dtype_np(dtype))
+
+
+@register("L2Normalization")
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "channel":
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    elif mode == "spatial":
+        denom = jnp.sqrt(
+            jnp.sum(jnp.square(x), axis=tuple(range(2, x.ndim)), keepdims=True) + eps
+        )
+    else:
+        denom = jnp.sqrt(
+            jnp.sum(jnp.square(x), axis=tuple(range(1, x.ndim)), keepdims=True) + eps
+        )
+    return x / denom

@@ -1,0 +1,225 @@
+"""Declarative operator registry.
+
+Reference parity: NNVM op registry (3rdparty/tvm/nnvm/include/nnvm/op.h) +
+imperative dispatch (src/imperative/imperative.cc Imperative::Invoke ~L90,
+imperative_utils.h PushFCompute ~L400).
+
+TPU-native design:
+  * an Operator's FCompute is a pure jax function ``fn(*arrays, **attrs)``;
+  * eager calls go through a per-(op, attrs) ``jax.jit`` cache — jax's own
+    C++ dispatch then caches per input signature, which plays the role of
+    the reference's engine push fast-path;
+  * shape/dtype inference falls out of jax abstract evaluation — there are
+    no separate FInferShape/FInferType functions to keep in sync;
+  * gradients come from ``jax.vjp`` captured at execution time (autograd.py),
+    replacing per-op FGradient registrations;
+  * inside a HybridBlock trace the inputs are jax tracers: the op function
+    is inlined into the outer jaxpr (CachedOp), with no tape recording —
+    exactly the reference split between Imperative::Invoke and CachedOp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError, canonical_kwargs
+from .. import engine
+
+__all__ = ["Operator", "register", "get_op", "invoke", "list_ops"]
+
+_OPS: Dict[str, "Operator"] = {}
+
+
+class Operator:
+    """A registered op: name, pure jax FCompute, and differentiability."""
+
+    def __init__(self, name: str, fn: Callable, differentiable: bool = True,
+                 doc: Optional[str] = None):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.__doc__ = doc or fn.__doc__
+        self._jit_cache: Dict[Any, Callable] = {}
+
+    def jitted(self, attrs: dict) -> Callable:
+        key = canonical_kwargs(attrs)
+        jfn = self._jit_cache.get(key)
+        if jfn is None:
+            fn = self.fn
+
+            @functools.wraps(fn)
+            def call(*arrays):
+                return fn(*arrays, **attrs)
+
+            import jax
+
+            jfn = jax.jit(call)
+            self._jit_cache[key] = jfn
+        return jfn
+
+    def __repr__(self):
+        return f"<Operator {self.name}>"
+
+
+def register(name: Optional[str] = None, differentiable: bool = True):
+    """Decorator: register a pure jax function as an operator."""
+
+    def deco(fn: Callable) -> Callable:
+        opname = name or fn.__name__
+        if opname in _OPS:
+            raise MXNetError(f"op {opname!r} registered twice")
+        _OPS[opname] = Operator(opname, fn, differentiable=differentiable)
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> Operator:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError(f"unknown operator {name!r}") from None
+
+
+def list_ops() -> List[str]:
+    return sorted(_OPS)
+
+
+def _is_tracer(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_float(arr) -> bool:
+    import numpy as np
+
+    return arr.dtype.kind in ("f", "V")  # V: bfloat16 shows as void in old numpy
+
+
+def invoke(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
+    """Execute `op` on NDArray inputs; returns NDArray or list of NDArrays.
+
+    This is the single dispatch point shared by eager mode, autograd
+    recording, and HybridBlock tracing (reference: MXImperativeInvokeEx).
+    `ctx` only matters for zero-input (creation) ops; otherwise outputs
+    follow their inputs' device, as in the reference.
+    """
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    arrays = [x._data for x in inputs]
+    if inputs:
+        ctx = inputs[0].context
+    elif ctx is None:
+        from ..context import current_context
+
+        ctx = current_context()
+
+    traced = any(_is_tracer(a) for a in arrays)
+    if traced:
+        arrays = _stop_detached(arrays, inputs)
+        outs = op.fn(*arrays, **attrs)
+    elif not arrays:
+        # creation op: place the result on ctx's device
+        import jax
+
+        with jax.default_device(ctx.jax_device):
+            outs = op.jitted(attrs)()
+    else:
+        jfn = op.jitted(attrs)
+        if (
+            autograd.is_recording()
+            and op.differentiable
+            and arrays
+            and any(_is_float(a) for a in arrays)
+        ):
+            outs, vjp_fn = _vjp(_wrap_detached(jfn, inputs), arrays)
+            out_list = outs if isinstance(outs, (tuple, list)) else [outs]
+            autograd.record_node(vjp_fn, arrays, list(out_list), input_nds=inputs)
+        else:
+            outs = jfn(*arrays)
+        if engine.is_naive():
+            import jax
+
+            jax.block_until_ready(outs)
+
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+    results = [NDArray(o, ctx=ctx) for o in out_list]
+    if out is not None:
+        if multi:
+            raise MXNetError(f"out= not supported for multi-output op {op.name}")
+        out._set_data(results[0]._data)
+        return out
+    return results if multi else results[0]
+
+
+def _vjp(jfn, arrays):
+    import jax
+
+    return jax.vjp(jfn, *arrays)
+
+
+def _stop_detached(arrays, inputs):
+    import jax
+
+    return [
+        jax.lax.stop_gradient(a) if getattr(nd, "_detached", False) else a
+        for a, nd in zip(arrays, inputs)
+    ]
+
+
+def _wrap_detached(fn, inputs):
+    """Stop gradient flow through inputs marked detach()ed, without copying
+    their buffers or changing their tape identity."""
+    mask = [getattr(nd, "_detached", False) for nd in inputs]
+    if not any(mask):
+        return fn
+    import jax
+
+    def wrapped(*arrays):
+        return fn(*[
+            jax.lax.stop_gradient(a) if m else a for a, m in zip(arrays, mask)
+        ])
+
+    return wrapped
+
+
+def invoke_by_name(name: str, inputs, out=None, **attrs):
+    return invoke(get_op(name), inputs, out=out, **attrs)
+
+
+def invoke_fn(fn, inputs, out=None):
+    """Execute an ad-hoc pure jax function on NDArray inputs with full
+    autograd-recording / tracing support but no jit cache (used by NDArray
+    indexing and other closures whose attrs aren't hashable)."""
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    arrays = [x._data for x in inputs]
+    ctx = inputs[0].context if inputs else None
+
+    traced = any(_is_tracer(a) for a in arrays)
+    if not traced and autograd.is_recording() and any(_is_float(a) for a in arrays):
+        outs, vjp_fn = _vjp(_wrap_detached(fn, inputs), arrays)
+        out_list = outs if isinstance(outs, (tuple, list)) else [outs]
+        autograd.record_node(vjp_fn, arrays, list(out_list), input_nds=inputs)
+    else:
+        if traced:
+            arrays = _stop_detached(arrays, inputs)
+        outs = fn(*arrays)
+        if not traced and engine.is_naive():
+            import jax
+
+            jax.block_until_ready(outs)
+
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+    results = [NDArray(o, ctx=ctx) for o in out_list]
+    if out is not None:
+        if multi:
+            raise MXNetError("out= not supported for multi-output functions")
+        out._set_data(results[0]._data)
+        return out
+    return results if multi else results[0]

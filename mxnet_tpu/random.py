@@ -1,0 +1,76 @@
+"""Stateful RNG facade over jax's functional keys.
+
+Reference parity: mx.random.seed (python/mxnet/random.py) over per-device
+Philox generators (include/mxnet/random_generator.h ~L100, ResourceRequest::
+kRandom).
+
+Design: a process-global key is split on every sampling call — the MXNet
+"stateful RNG resource" becomes a counter-free key chain.  Inside a
+HybridBlock trace there is no concrete key; the CachedOp threads a key
+argument through the traced function and installs a *trace key provider*
+here, so ops like Dropout stay pure and cache-friendly.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = ["seed", "next_key", "set_trace_key_provider"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_provider = None
+
+
+_state = _State()
+_DEFAULT_SEED = 0
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def seed(seed_state: Optional[int] = None, ctx="all") -> None:
+    """Seed the global generator (reference: mx.random.seed)."""
+    if seed_state is None:
+        seed_state = int(time.time() * 1e6) & 0x7FFFFFFF
+    _state.key = _jax().random.PRNGKey(int(seed_state))
+
+
+class _TraceKeyProvider:
+    """Splits keys off a traced key argument during CachedOp tracing."""
+
+    def __init__(self, key_tracer):
+        self._key = key_tracer
+        self.used = False
+
+    def next(self):
+        jax = _jax()
+        self.used = True
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+def set_trace_key_provider(provider) -> Optional[_TraceKeyProvider]:
+    prev = _state.trace_provider
+    _state.trace_provider = provider
+    return prev
+
+
+def in_trace() -> bool:
+    return _state.trace_provider is not None
+
+
+def next_key():
+    """Next RNG key: concrete in eager mode, traced inside a CachedOp trace."""
+    if _state.trace_provider is not None:
+        return _state.trace_provider.next()
+    if _state.key is None:
+        _state.key = _jax().random.PRNGKey(_DEFAULT_SEED)
+    _state.key, sub = _jax().random.split(_state.key)
+    return sub

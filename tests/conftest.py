@@ -1,0 +1,30 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+The driver environment boots python with the axon TPU backend registered
+(sitecustomize imports jax before we run).  jax leaves backend *initialization*
+lazy, so re-pointing the platform here — before any test touches a device —
+reliably gives us an 8-way CPU mesh for sharding tests, per SURVEY §4.4
+(xla_force_host_platform_device_count).
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    yield

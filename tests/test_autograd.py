@@ -1,0 +1,149 @@
+"""Autograd tape tests (reference model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(2 * x.asnumpy()),
+                               rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_pause():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 5  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # grad flows only through the second x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_multi_output_backward():
+    x = nd.array([1.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.sqrt(x).sum() + (x * x).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               0.5 / np.sqrt(x.asnumpy()) + 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 3
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_grad_through_indexing():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1, 1], [0, 0]])
+
+
+def test_custom_function():
+    class MulConst(autograd.Function):
+        def forward(self, x):
+            return x * 7
+
+        def backward(self, dy):
+            return dy * 7
+
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    f = MulConst()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0, 7.0])
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [4.0])
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    arr = y.asnumpy()
+    assert (arr == 0).sum() > 10  # some were dropped
+    assert abs(arr.mean() - 1.0) < 0.3  # scaled to keep expectation

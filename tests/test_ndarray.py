@@ -1,0 +1,197 @@
+"""NDArray semantics tests (reference model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert x.context == mx.cpu()
+    np.testing.assert_array_equal(x.asnumpy(), np.zeros((2, 3), np.float32))
+
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    assert y.sum().asscalar() == 4
+
+    z = nd.full((2, 2), 7.5)
+    assert z.asnumpy().flat[0] == 7.5
+
+    a = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(a.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_array_roundtrip():
+    src = np.random.randn(3, 4).astype(np.float32)
+    x = nd.array(src)
+    np.testing.assert_allclose(x.asnumpy(), src)
+    # float64 downcasts to float32 like MXNet
+    x64 = nd.array(np.random.randn(2).astype(np.float64))
+    assert x64.dtype == np.float32
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 / a).asnumpy(), 1 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+    np.testing.assert_allclose((a / b).asnumpy(), a.asnumpy() / b.asnumpy())
+
+
+def test_inplace_mutation_versioning():
+    a = nd.ones((2, 2))
+    v0 = a.version
+    a += 1
+    assert a.version > v0
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+    a[:] = 0
+    np.testing.assert_allclose(a.asnumpy(), np.zeros((2, 2)))
+
+
+def test_indexing():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_array_equal(x[1].asnumpy(), np.arange(24).reshape(2, 3, 4)[1])
+    np.testing.assert_array_equal(x[:, 1].asnumpy(),
+                                  np.arange(24).reshape(2, 3, 4)[:, 1])
+    x[0, 0, 0] = 99
+    assert x.asnumpy()[0, 0, 0] == 99
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_reshape_transpose():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    assert x.reshape(4, 3).shape == (4, 3)
+    assert x.reshape((2, 6)).shape == (2, 6)
+    assert x.reshape(-1, 2).shape == (6, 2)
+    assert x.reshape(0, -1).shape == (3, 4)
+    assert x.T.shape == (4, 3)
+    assert x.transpose().shape == (4, 3)
+    assert nd.transpose(x, axes=(1, 0)).shape == (4, 3)
+
+
+def test_reduce_ops():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert x.sum().asscalar() == 66
+    np.testing.assert_allclose(x.sum(axis=0).asnumpy(), x.asnumpy().sum(axis=0))
+    np.testing.assert_allclose(nd.mean(x, axis=1).asnumpy(), x.asnumpy().mean(axis=1))
+    np.testing.assert_allclose(nd.max(x).asnumpy(), 11)
+    assert x.argmax().asscalar() == 11.0
+    assert nd.argmax(x, axis=1).asnumpy().tolist() == [3, 3, 3]
+
+
+def test_dot():
+    a = nd.array(np.random.randn(3, 4).astype(np.float32))
+    b = nd.array(np.random.randn(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    c = nd.array(np.random.randn(2, 3, 4).astype(np.float32))
+    d = nd.array(np.random.randn(2, 4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.batch_dot(c, d).asnumpy(),
+                               c.asnumpy() @ d.asnumpy(), rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=1)
+    assert c.shape == (2, 6)
+    s = nd.split(c, num_outputs=2, axis=1)
+    assert isinstance(s, list) and len(s) == 2
+    np.testing.assert_allclose(s[0].asnumpy(), a.asnumpy())
+    st = nd.stack(a, b, axis=0)
+    assert st.shape == (2, 2, 3)
+
+
+def test_elemwise_math():
+    x = nd.array([0.5, 1.0, 2.0])
+    np.testing.assert_allclose(nd.exp(x).asnumpy(), np.exp(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.log(x).asnumpy(), np.log(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.sqrt(x).asnumpy(), np.sqrt(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.relu(nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
+    np.testing.assert_allclose(nd.sigmoid(nd.array([0.0])).asnumpy(), [0.5])
+    np.testing.assert_allclose(nd.clip(x, 0.6, 1.5).asnumpy(), [0.6, 1.0, 1.5])
+
+
+def test_take_onehot_where():
+    w = nd.array(np.arange(10, dtype=np.float32).reshape(5, 2))
+    idx = nd.array([0, 3], dtype="int32")
+    np.testing.assert_allclose(nd.take(w, idx).asnumpy(), w.asnumpy()[[0, 3]])
+    oh = nd.one_hot(nd.array([1, 2], dtype="int32"), 4)
+    np.testing.assert_allclose(oh.asnumpy(), [[0, 1, 0, 0], [0, 0, 1, 0]])
+    cond = nd.array([1.0, 0.0])
+    out = nd.where(cond, nd.array([1.0, 1.0]), nd.array([2.0, 2.0]))
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+
+def test_astype_copy_context():
+    x = nd.ones((2, 2))
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.copyto(mx.cpu())
+    np.testing.assert_allclose(z.asnumpy(), x.asnumpy())
+    w = x.as_in_context(mx.cpu())
+    assert w.context == mx.cpu()
+
+
+def test_bfloat16():
+    x = nd.ones((4, 4), dtype="bfloat16")
+    y = (x * 3).sum()
+    assert y.asnumpy().astype(np.float32) == 48.0
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.bin")
+    a = nd.array(np.random.randn(3, 3).astype(np.float32))
+    b = nd.ones((2,), dtype="int32")
+    nd.save(f, {"a": a, "b": b})
+    loaded = nd.load(f)
+    np.testing.assert_allclose(loaded["a"].asnumpy(), a.asnumpy())
+    np.testing.assert_array_equal(loaded["b"].asnumpy(), b.asnumpy())
+    nd.save(f, [a, b])
+    lst = nd.load(f)
+    assert isinstance(lst, list) and len(lst) == 2
+
+
+def test_random_ops():
+    mx.random.seed(0)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= float(u.min().asscalar()) and float(u.max().asscalar()) <= 1
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.mean().asscalar())) < 0.2
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    r = nd.random.randint(0, 10, shape=(20,))
+    assert r.dtype == np.int32
+
+
+def test_waitall():
+    x = nd.ones((10, 10))
+    y = x * 2
+    mx.nd.waitall()
+    np.testing.assert_allclose(y.asnumpy(), 2 * np.ones((10, 10)))
+
+
+def test_op_methods_via_getattr():
+    x = nd.array([[1.0, -2.0], [3.0, -4.0]])
+    np.testing.assert_allclose(x.relu().asnumpy(), [[1, 0], [3, 0]])
+    np.testing.assert_allclose(x.square().asnumpy(), x.asnumpy() ** 2)
