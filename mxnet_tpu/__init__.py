@@ -23,6 +23,16 @@ from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import autograd
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import metric
+from . import kvstore
+from .kvstore import KVStore
+from . import recordio
+from . import gluon
+from . import parallel
 
 
 def waitall():

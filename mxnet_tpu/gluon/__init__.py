@@ -1,0 +1,12 @@
+"""Gluon: the imperative high-level API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load
+from .trainer import Trainer
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib

@@ -1,0 +1,441 @@
+"""Gluon Block / HybridBlock and the CachedOp graph executor.
+
+Reference parity: python/mxnet/gluon/block.py (Block.__call__ ~L500,
+HybridBlock.hybridize ~L700, _build_cache ~L750) over src/imperative/
+cached_op.cc (CachedOp::Forward ~L700, GetForwardGraph ~L200).
+
+TPU-native design: hybridize() does not build an nnvm graph — calling a
+hybridized block traces its eager forward (all NDArray ops hit the traced
+branch of ops.registry) into a jaxpr, which jax.jit compiles into ONE XLA
+executable.  XLA performs the memory planning, fusion and bulking that
+PlanMemory / FusedOp / engine bulk-exec do in the reference.  The
+per-input-signature executable cache that CachedOp keeps (GetForwardGraph
+re-planning on new shapes) is exactly jax.jit's signature cache.
+
+Mutable-state parity: parameter reads inside the trace are substituted with
+traced values (see parameter.begin_trace); BatchNorm-style aux mutations are
+collected during the trace, returned as extra outputs, and applied by buffer
+swap after each call; dropout RNG becomes an explicit key argument threaded
+through the traced function (random.set_trace_key_provider).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import autograd
+from .. import random as _random
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
+                        begin_trace, end_trace, trace_active)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+class _BlockScope(threading.local):
+    """Name-scope manager (reference: block.py _BlockScope)."""
+
+    def __init__(self):
+        self._current: Optional["Block"] = None
+        self._counters: Dict[str, int] = {}
+
+    def create(self, prefix, params, hint):
+        current = self._current
+        if current is None:
+            if prefix is None:
+                count = self._counters.get(hint, 0)
+                self._counters[hint] = count + 1
+                prefix = f"{hint}{count}_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._scope_counters.get(hint, 0)
+            current._scope_counters[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current.prefix + prefix, params
+
+
+_scope = _BlockScope()
+
+
+class _NameScopeCtx:
+    def __init__(self, block):
+        self._block = block
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _scope._current
+        _scope._current = self._block
+        return self
+
+    def __exit__(self, *exc):
+        _scope._current = self._prev
+        return False
+
+
+class Block:
+    """Base building block (reference: gluon/block.py Block)."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 params: Optional[ParameterDict] = None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _scope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope_counters: Dict[str, int] = {}
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    def _alias(self) -> str:
+        return self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        return _NameScopeCtx(self)
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            f"  ({key}): {_indent(repr(block), 2)}"
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret._params.update(
+                {k: v for k, v in self._params.items() if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        params = self.collect_params()
+        params.save(filename, strip_prefix=self.prefix)
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current") -> None:
+        params = self.collect_params()
+        params.load(filename, ctx, allow_missing, ignore_extra,
+                    restore_prefix=self.prefix)
+
+    # legacy names
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self._params.values():
+            param.cast(dtype)
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        raise NotImplementedError(
+            "summary() lands with the visualization module")
+
+
+def _indent(s, n):
+    pad = " " * n
+    return ("\n" + pad).join(s.split("\n"))
+
+
+class CachedOp:
+    """The hybridization executor: block forward as ONE jitted function.
+
+    Reference: src/imperative/cached_op.cc.  Signature cache and memory
+    planning are delegated to jax.jit / XLA; we keep one traced+jitted
+    callable per train-mode flag (dropout/BN change the traced program).
+    """
+
+    def __init__(self, block: "HybridBlock", flags: Dict[str, Any]):
+        self.block = block
+        self.flags = flags
+        # keyed by (train, input treedef): inputs may be arbitrary pytrees of
+        # NDArrays (e.g. RNN layers take (x, [h, c]))
+        self._jitted: Dict[Any, Any] = {}
+        self._param_items: Optional[List] = None  # [(name, Parameter)]
+        self._aux_params: Dict[Any, List[Parameter]] = {}
+        self._out_treedef: Dict[Any, Any] = {}
+        self._n_out: Dict[Any, int] = {}
+
+    def _ensure_params(self, ctx):
+        if self._param_items is None:
+            params = self.block.collect_params()
+            self._param_items = list(params.items())
+        # triggers deferred-init errors before tracing
+        return [p.data(ctx) for _, p in self._param_items]
+
+    @staticmethod
+    def _flatten(args):
+        import jax.tree_util as jtu
+
+        from ..ndarray import NDArray
+
+        leaves, treedef = jtu.tree_flatten(
+            list(args), is_leaf=lambda x: isinstance(x, NDArray))
+        return leaves, treedef
+
+    def _build(self, cache_key, train: bool, ctx, in_treedef):
+        import jax
+        import jax.tree_util as jtu
+
+        block = self.block
+        param_list = [p for _, p in self._param_items]
+        cached = self
+
+        def fn(param_arrays, key, *input_arrays):
+            from ..ndarray import NDArray
+
+            param_map = {
+                p: NDArray(arr, ctx=ctx)
+                for p, arr in zip(param_list, param_arrays)
+            }
+            nd_leaves = [NDArray(a, ctx=ctx) for a in input_arrays]
+            nd_inputs = jtu.tree_unflatten(in_treedef, nd_leaves)
+            prev_trace = begin_trace(param_map, ctx)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(train)
+            prev_key = _random.set_trace_key_provider(
+                _random._TraceKeyProvider(key))
+            try:
+                out = block.forward(*nd_inputs)
+            finally:
+                state = end_trace(prev_trace)
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+                _random.set_trace_key_provider(prev_key)
+            out_nds, out_treedef = jtu.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            cached._out_treedef[cache_key] = out_treedef
+            cached._n_out[cache_key] = len(out_nds)
+            cached._aux_params[cache_key] = [p for p, _ in state["aux"]]
+            aux_vals = [v._data for _, v in state["aux"]]
+            return tuple(o._data for o in out_nds) + tuple(aux_vals)
+
+        return jax.jit(fn)
+
+    def __call__(self, *inputs):
+        import jax.tree_util as jtu
+
+        from ..ndarray import NDArray
+
+        in_nds, in_treedef = self._flatten(inputs)
+        ctx = in_nds[0].context
+        param_nds = self._ensure_params(ctx)
+        train = autograd.is_training()
+        cache_key = (train, in_treedef)
+        jfn = self._jitted.get(cache_key)
+        if jfn is None:
+            jfn = self._build(cache_key, train, ctx, in_treedef)
+            self._jitted[cache_key] = jfn
+
+        key = _random.next_key()
+        arrays = tuple(p._data for p in param_nds)
+        in_arrays = [x._data for x in in_nds]
+
+        recording = autograd.is_recording()
+        if recording:
+            import jax
+
+            outs, vjp_fn = jax.vjp(jfn, arrays, key, *in_arrays)
+            flat_inputs = list(arrays) + [key] + in_arrays
+
+            def adapter(cots):
+                pc, kc, *ic = vjp_fn(cots if isinstance(cots, tuple) else (cots,))
+                return list(pc) + [kc] + list(ic)
+
+            autograd.record_node(adapter, flat_inputs, list(outs),
+                                 input_nds=param_nds + in_nds)
+        else:
+            outs = jfn(arrays, key, *in_arrays)
+
+        n_out = self._n_out[cache_key]
+        out_nds = [NDArray(o, ctx=ctx) for o in outs[:n_out]]
+        # apply collected aux-state updates by buffer swap
+        for p, new in zip(self._aux_params[cache_key], outs[n_out:]):
+            target = p._data.get(ctx)
+            if target is not None:
+                target._set_data(new)
+        return jtu.tree_unflatten(self._out_treedef[cache_key], out_nds)
+
+
+class HybridBlock(Block):
+    """A Block compilable into one XLA executable via hybridize()."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags: Dict[str, Any] = {}
+        self._cached_op: Optional[CachedOp] = None
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, inline_limit: int = 2,
+                  forward_bulk_size: Optional[int] = None,
+                  backward_bulk_size: Optional[int] = None) -> None:
+        self._active = active
+        self._flags = {"static_alloc": static_alloc,
+                       "static_shape": static_shape}
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape)
+
+    def _clear_cached_op(self) -> None:
+        self._cached_op = None
+
+    def infer_shape(self, *args) -> None:
+        """Shape-inference hook for deferred parameter init.  Built-in layers
+        override this; composite blocks rely on their children."""
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-initialized parameters but "
+            "no infer_shape(); initialize with explicit shapes or override "
+            "infer_shape")
+
+    def _deferred_infer_shape(self, *args) -> None:
+        self.infer_shape(*args)
+        for param in self._reg_params.values():
+            if param._deferred is not None:
+                param._finish_deferred_init()
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def __call__(self, *args):
+        # inside an active trace, always run the eager path (ops see tracers)
+        if self._active and not trace_active():
+            try:
+                return self._call_cached_op(*args)
+            except DeferredInitializationError:
+                self._infer_and_retry_params(*args)
+                return self._call_cached_op(*args)
+        return super().__call__(*args)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self, self._flags)
+        return self._cached_op(*args)
+
+    def _infer_and_retry_params(self, *args) -> None:
+        # Run one eager forward: each leaf layer resolves its own deferred
+        # params via its infer_shape on the way through.
+        with autograd.pause(train_mode=autograd.is_training()):
+            super().__call__(*args)
+
+    def forward(self, x, *args):
+        """Dispatch to hybrid_forward with params bound (reference ~L750)."""
+        ctx = x.context
+        try:
+            params = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, *args)
+            params = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        from .. import ndarray as F
+
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path: str, epoch: int = 0):
+        """Serialize params (+ a json stub) for deployment.
+
+        The reference emits {path}-symbol.json + params; the traced-jaxpr
+        equivalent of the symbol graph lands with the Symbol facade.
+        """
+        import json
+
+        params = self.collect_params()
+        params.save(f"{path}-{epoch:04d}.params")
+        meta = {"format": "mxnet_tpu-hybrid", "class": type(self).__name__,
+                "params": sorted(params.keys())}
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f, indent=2)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a symbol graph (reference: SymbolBlock).
+
+    Lands with the Symbol facade module; kept as a named placeholder so
+    imports of the public surface don't break."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "SymbolBlock requires the Symbol facade (see mxnet_tpu.symbol)")

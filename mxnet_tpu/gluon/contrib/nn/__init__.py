@@ -1,0 +1,58 @@
+"""Contrib layers (reference: gluon/contrib/nn/basic_layers.py).
+
+SyncBatchNorm: in the reference this is cross-GPU BN with a hand-written
+NCCL reduce (contrib/nn SyncBatchNorm ~L100).  In the eager per-device path
+we fall back to per-device stats (documented divergence); under the fused
+pjit step the batch axis is global, so ordinary BatchNorm IS sync-BN —
+XLA computes batch statistics over the sharded batch with an ICI all-reduce,
+which is the TPU-native realization of SyncBatchNorm.
+"""
+from ...nn.basic_layers import BatchNorm as _BatchNorm
+from ...block import HybridBlock
+
+__all__ = ["SyncBatchNorm", "HybridConcurrent", "Concurrent", "Identity"]
+
+
+class SyncBatchNorm(_BatchNorm):
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", prefix=None, params=None):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, prefix=prefix, params=params)
+
+
+from ...nn.basic_layers import HybridSequential as _HS
+from ...nn.basic_layers import Sequential as _S
+
+
+class HybridConcurrent(HybridBlock):
+    """Parallel application + concat (reference: contrib/nn HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x

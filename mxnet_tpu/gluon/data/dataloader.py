@@ -1,0 +1,95 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py ~L400).
+
+The reference uses multiprocessing workers passing NDArrays through POSIX
+shared memory (cpu_shared storage).  On TPU the input pipeline's heavy
+lifting (RecordIO decode/augment) belongs to the native C++ pipeline
+(mxnet_tpu.io); this Python DataLoader covers the Dataset/transform path
+with an optional thread pool — processes + shm are a poor fit for feeding a
+single accelerator process and XLA host callbacks.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py ~L130)."""
+    from ... import ndarray as nd
+    from ...ndarray import NDArray
+
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    return nd.array(arr, dtype=arr.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler=None, last_batch=None,
+                 batch_sampler=None, batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 pin_device_id: int = 0, prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout: int = 120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = (RandomSampler(len(dataset)) if shuffle
+                           else SequentialSampler(len(dataset)))
+            elif shuffle:
+                raise MXNetError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def _load(self, indices) -> object:
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._load(batch)
+            return
+        # thread pool with bounded prefetch (double buffering)
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            batches = iter(self._batch_sampler)
+            futures = []
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    futures.append(pool.submit(self._load, next(batches)))
+            except StopIteration:
+                pass
+            while futures:
+                fut = futures.pop(0)
+                try:
+                    futures.append(pool.submit(self._load, next(batches)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,3 @@
+"""Vision datasets and transforms (reference: gluon/data/vision/)."""
+from . import transforms
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset

@@ -1,0 +1,184 @@
+"""Vision transforms (reference: gluon/data/vision/transforms.py ~L1-500,
+backed by src/operator/image/ ops).  Transforms are HybridBlocks operating
+on HWC uint8/float images, like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms):
+        super().__init__()
+        with self.name_scope():
+            for t in transforms:
+                self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: image.to_tensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.Cast(x, dtype="float32") / 255.0
+        if x.ndim == 4:
+            return x.transpose((0, 3, 1, 2))
+        return x.transpose((2, 0, 1))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        from .... import ndarray as nd
+
+        mean = nd.array(self._mean, ctx=x.context)
+        std = nd.array(self._std, ctx=x.context)
+        return (x - mean) / std
+
+
+class Resize(HybridBlock):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def hybrid_forward(self, F, x):
+        import jax.image
+
+        from ....ops import registry as _reg
+
+        w, h = self._size
+
+        def fn(img):
+            if img.ndim == 3:
+                return jax.image.resize(
+                    img.astype("float32"), (h, w, img.shape[2]),
+                    method="bilinear").astype(img.dtype)
+            return jax.image.resize(
+                img.astype("float32"), (img.shape[0], h, w, img.shape[3]),
+                method="bilinear").astype(img.dtype)
+
+        return _reg.invoke_fn(fn, [x])
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop + resize (reference: transforms ~L300)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax.image
+
+        from ....ops import registry as _reg
+
+        H, W = int(x.shape[-3]), int(x.shape[-2])
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                break
+        else:
+            crop = x
+        tw, th = self._size
+
+        def fn(img):
+            return jax.image.resize(
+                img.astype("float32"), (th, tw, img.shape[-1]),
+                method="bilinear").astype(img.dtype)
+
+        return _reg.invoke_fn(fn, [crop])
+
+
+class _RandomApply(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return self._apply(x)
+        return x
+
+
+class RandomFlipLeftRight(_RandomApply):
+    def _apply(self, x):
+        return x[..., :, ::-1, :]
+
+
+class RandomFlipTopBottom(_RandomApply):
+    def _apply(self, x):
+        return x[..., ::-1, :, :]
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._b, self._b)
+        return x * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._c, self._c)
+        gray = x.mean()
+        return x * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        from .... import ndarray as nd
+
+        alpha = 1.0 + np.random.uniform(-self._s, self._s)
+        coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32), ctx=x.context)
+        gray = (x * coef.reshape(1, 1, 3)).sum(axis=-1, keepdims=True)
+        return x * alpha + gray * (1 - alpha)

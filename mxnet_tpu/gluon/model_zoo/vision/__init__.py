@@ -1,0 +1,25 @@
+"""Model zoo: vision (reference: gluon/model_zoo/vision/__init__.py).
+
+get_model resolves by name; families land incrementally (resnet first —
+the BASELINE flagship; alexnet/vgg/mobilenet/squeezenet/densenet follow).
+"""
+from ....base import MXNetError
+from .resnet import *
+from .resnet import __all__ as _resnet_all
+
+_models = {name: globals()[name] for name in _resnet_all
+           if name.startswith("resnet")}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    try:
+        return _models[name](**kwargs)
+    except KeyError:
+        raise MXNetError(
+            f"Model {name} is not supported yet. Available: "
+            f"{sorted(_models)}") from None
+
+
+def register_model(name, fn):
+    _models[name.lower()] = fn

@@ -1,0 +1,4 @@
+"""Gluon neural-network layers (reference: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .basic_layers import *
+from .conv_layers import *

@@ -1,0 +1,278 @@
+"""Gluon convolution / pooling layers.
+
+Reference parity: python/mxnet/gluon/nn/conv_layers.py (~L1-1200): Conv1D/2D/3D,
+Conv2DTranspose/Conv3DTranspose, Max/Avg pooling 1D/2D/3D, global pooling.
+NCHW-family layouts only (TPU/XLA handles layout internally).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D"]
+
+
+def _tup(val, n):
+    return (val,) * n if isinstance(val, int) else tuple(val)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._channels = channels
+        self._in_channels = in_channels
+        ndim = len(kernel_size)
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "no_bias": not use_bias}
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        self._op_name = op_name
+        self._act_type = activation
+        with self.name_scope():
+            if op_name == "Convolution":
+                wshape = (channels, in_channels // groups if in_channels else 0) \
+                    + kernel_size
+            else:  # Deconvolution weight layout (in, out/group, *k)
+                wshape = (in_channels, channels // groups if channels else 0) \
+                    + kernel_size
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            self.bias = (self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        in_c = int(x.shape[1])
+        groups = self._kwargs["num_group"]
+        k = self._kwargs["kernel"]
+        if self._op_name == "Convolution":
+            self.weight._set_shape_if_deferred(
+                (self._channels, in_c // groups) + tuple(k))
+        else:
+            self.weight._set_shape_if_deferred(
+                (in_c, self._channels // groups) + tuple(k))
+        if self.bias is not None:
+            self.bias._set_shape_if_deferred((self._channels,))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            out = op(x, weight, **self._kwargs)
+        else:
+            out = op(x, weight, bias, **self._kwargs)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 1), prefix=prefix,
+                         params=params)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 2), prefix=prefix,
+                         params=params)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tup(output_padding, 3), prefix=prefix,
+                         params=params)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "pool_type": pool_type, "global_pool": global_pool,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']}, "
+                f"padding={self._kwargs['pad']})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 1),
+                         None if strides is None else _tup(strides, 1),
+                         _tup(padding, 1), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2),
+                         None if strides is None else _tup(strides, 2),
+                         _tup(padding, 2), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3),
+                         None if strides is None else _tup(strides, 3),
+                         _tup(padding, 3), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, prefix=None,
+                 params=None):
+        super().__init__(_tup(pool_size, 1),
+                         None if strides is None else _tup(strides, 1),
+                         _tup(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2),
+                         None if strides is None else _tup(strides, 2),
+                         _tup(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3),
+                         None if strides is None else _tup(strides, 3),
+                         _tup(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class _GlobalPool(_Pooling):
+    def __init__(self, pool_type, ndim, layout, prefix=None, params=None):
+        super().__init__((1,) * ndim, (1,) * ndim, (0,) * ndim, False, True,
+                         pool_type, layout, prefix=prefix, params=params)
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__("max", 1, layout, prefix=prefix, params=params)
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__("max", 2, layout, prefix=prefix, params=params)
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__("max", 3, layout, prefix=prefix, params=params)
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__("avg", 1, layout, prefix=prefix, params=params)
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__("avg", 2, layout, prefix=prefix, params=params)
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__("avg", 3, layout, prefix=prefix, params=params)

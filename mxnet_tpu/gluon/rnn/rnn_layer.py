@@ -1,0 +1,193 @@
+"""Fused RNN layers (reference: python/mxnet/gluon/rnn/rnn_layer.py ~L1-600:
+RNN/LSTM/GRU dispatching to the fused `RNN` op with cuDNN/MIOpen backend).
+
+Here the fused backend is the lax.scan op `_fused_rnn` (ops/rnn_ops.py).
+Parameter naming matches the reference ({l,r}{i}_{i2h,h2h}_{weight,bias})
+so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC', 'NTC']"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in (["l", "r"] if bidirectional else ["l"]):
+                    name = f"{j}{i}_i2h_weight"
+                    setattr(self, name, self.params.get(
+                        name, shape=(ng * nh, ni), allow_deferred_init=True,
+                        init=i2h_weight_initializer))
+                    name = f"{j}{i}_h2h_weight"
+                    setattr(self, name, self.params.get(
+                        name, shape=(ng * nh, nh), allow_deferred_init=True,
+                        init=h2h_weight_initializer))
+                    name = f"{j}{i}_i2h_bias"
+                    setattr(self, name, self.params.get(
+                        name, shape=(ng * nh,), allow_deferred_init=True,
+                        init=i2h_bias_initializer))
+                    name = f"{j}{i}_h2h_bias"
+                    setattr(self, name, self.params.get(
+                        name, shape=(ng * nh,), allow_deferred_init=True,
+                        init=h2h_bias_initializer))
+                ni = nh * self._dir
+
+    def _alias(self):
+        # called during __init__ before _mode is set; fall back to class name
+        return getattr(self, "_mode", type(self).__name__.lower())
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+            ]
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **{**info, **kwargs}))
+        return states
+
+    def infer_shape(self, x, *args):
+        ni = int(x.shape[-1])  # feature dim is the last axis in TNC and NTC
+        ng, nh = self._gates, self._hidden_size
+        layer_input = ni
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                getattr(self, f"{j}{i}_i2h_weight")._set_shape_if_deferred(
+                    (ng * nh, layer_input))
+            layer_input = nh * self._dir
+
+    def __call__(self, inputs, states=None, **kwargs):
+        # The traced function ALWAYS returns (out, state_list) so the CachedOp
+        # output structure is independent of how the user called us; unwrap
+        # here when states were omitted.
+        skip_states = states is None
+        if states is None:
+            batch = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch, ctx=inputs.context,
+                                      dtype=inputs.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out = super().__call__(inputs, list(states), **kwargs)
+        if skip_states:
+            return out[0]
+        return out
+
+    def forward(self, x, states):
+        ctx = x.context
+        from ..parameter import DeferredInitializationError
+
+        try:
+            params = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, states)
+            for p in self._reg_params.values():
+                if p._deferred is not None:
+                    p._finish_deferred_init()
+            params = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        from ... import ndarray as F
+
+        return self.hybrid_forward(F, x, states, **params)
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        from ... import autograd
+        from ... import random as _rng
+        from ...ndarray import NDArray
+        from ...ops import registry as _reg
+
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        weights = []
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                weights.extend([
+                    params[f"{j}{i}_i2h_weight"],
+                    params[f"{j}{i}_h2h_weight"],
+                    params[f"{j}{i}_i2h_bias"],
+                    params[f"{j}{i}_h2h_bias"],
+                ])
+        state_h = states[0]
+        state_c = states[1] if self._mode == "lstm" else F.zeros_like(states[0])
+        key = NDArray(_rng.next_key(), ctx=inputs.context)
+        outs = _reg.invoke_by_name(
+            "_fused_rnn", [inputs, key, state_h, state_c] + weights,
+            mode=self._mode, state_size=self._hidden_size,
+            num_layers=self._num_layers, bidirectional=self._dir == 2,
+            p=self._dropout, training=autograd.is_training())
+        out = outs[0]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return out, list(outs[1:])
+
+
+class RNN(_RNNLayer):
+    """Vanilla RNN layer (relu/tanh) — reference rnn_layer.py RNN."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation,
+                         prefix=prefix, params=params)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", prefix=prefix,
+                         params=params)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", prefix=prefix,
+                         params=params)

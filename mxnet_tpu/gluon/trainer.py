@@ -1,0 +1,199 @@
+"""Gluon Trainer.
+
+Reference parity: python/mxnet/gluon/trainer.py (_init_kvstore decision table
+~L150, allreduce_grads ~L250, step/update ~L300, save/load_states ~L400).
+
+On a single device the Trainer applies fused optimizer ops directly; on
+multiple devices it preserves KVStore semantics (push grads / server update /
+pull weights).  The throughput path for a full pod is the fused pjit step in
+mxnet_tpu.parallel — this class is the semantic-parity imperative path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    f"First argument must contain Parameters, got {type(param)}")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._trainer = self
+        self._compression_params = compression_params
+        self._contains_sparse_grad = False
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updaters = None
+        self._params_to_init: List[Parameter] = []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an "
+                    "optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(
+                optimizer, param_dict=param_dict, **optimizer_params)
+
+    # ------------------------------------------------------------------
+    @property
+    def optimizer(self) -> opt_mod.Optimizer:
+        return self._optimizer
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def _init_kvstore(self) -> None:
+        config = self._kvstore_params
+        ctx_list = self._check_contexts()
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        kv = None
+        if kvstore:
+            if isinstance(kvstore, kvs_mod.KVStore):
+                kv = kvstore
+            elif len(ctx_list) > 1 or "dist" in str(kvstore):
+                kv = kvs_mod.create(kvstore)
+        if kv is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                kv.set_updater(opt_mod.get_updater(self._optimizer))
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    kv.init(i, param.data(param.list_ctx()[0]))
+        if not self._update_on_kvstore:
+            n_dev = len(ctx_list)
+            self._updaters = [opt_mod.get_updater(self._optimizer)
+                              for _ in range(n_dev)]
+        self._kv_initialized = True
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None else None
+            if contexts is None:
+                contexts = ctx
+        return contexts or []
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        # dense emulation: plain pull
+        if self._kvstore is not None:
+            i = self._param2idx[parameter.name]
+            self._kvstore.pull(i, out)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """Rescale grads by 1/batch_size, aggregate across devices, update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() when parameters are updated on kvstore "
+                "is not supported (reference behavior)")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self) -> None:
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.list_grad())
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_grad())
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() when parameters are updated on kvstore is not "
+                "supported (call step() instead)")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False) -> None:
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            # raises a clear error for never-initialized / still-deferred
+            # parameters (reference behavior: step before init is an error)
+            param._check_initialized()
+            if self._update_on_kvstore:
+                # server updated the stored weight during push; fetch it
+                self._kvstore.pull(i, param.list_data())
+                continue
+            for upd, w, g in zip(self._updaters, param.list_data(),
+                                 param.list_grad()):
+                upd(i, g, w)
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname: str) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname: str) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._optimizer

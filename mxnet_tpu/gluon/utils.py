@@ -1,0 +1,86 @@
+"""Gluon utilities.
+
+Reference parity: python/mxnet/gluon/utils.py — split_data/split_and_load
+(~L40, the data-parallel batch sharder), clip_global_norm, check_sha1,
+download (stubbed: zero-egress environments).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List:
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise MXNetError(
+            f"Too many slices: data with shape {data.shape} only has {size} "
+            f"entries on axis {batch_axis} but {num_slice} slices requested")
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    if not even_split and size % num_slice != 0:
+        slices = [
+            _slice_axis(data, batch_axis, i * step, (i + 1) * step)
+            for i in range(num_slice - 1)
+        ]
+        slices.append(_slice_axis(data, batch_axis, (num_slice - 1) * step, size))
+        return slices
+    return [
+        _slice_axis(data, batch_axis, i * step, (i + 1) * step)
+        for i in range(num_slice)
+    ]
+
+
+def _slice_axis(data, axis, begin, end):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+def split_and_load(data, ctx_list: List[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List:
+    """Shard a batch across contexts (the Gluon data-parallel entry point).
+
+    On TPU the per-context shards feed either per-device eager forward or the
+    sharded pjit path in mxnet_tpu.parallel."""
+    from ..ndarray import NDArray, array
+
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [piece.as_in_context(ctx) for piece, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm: float, check_isfinite: bool = True):
+    """Rescale arrays so their joint L2 norm is at most max_norm."""
+    import jax.numpy as jnp
+
+    if not arrays:
+        raise MXNetError("clip_global_norm requires at least one array")
+    total = None
+    for arr in arrays:
+        sq = jnp.sum(jnp.square(arr._data.astype(jnp.float32)))
+        total = sq if total is None else total + sq
+    norm = float(jnp.sqrt(total))
+    if check_isfinite and not np.isfinite(norm):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set_data(arr._data * scale)
+    return norm

@@ -1,0 +1,248 @@
+"""Weight initializers.
+
+Reference parity: python/mxnet/initializer.py (Initializer registry, Uniform,
+Normal, Xavier, MSRAPrelu, Orthogonal, Bilinear, One/Zero/Constant).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Constant", "Zero", "One",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(init, **kwargs) -> "Initializer":
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        key = init.lower()
+        if key not in _REGISTRY:
+            raise MXNetError(f"unknown initializer {init!r}")
+        return _REGISTRY[key](**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer; subclasses fill a numpy array in-place.
+
+    Using host-side numpy (then device_put) keeps initialization independent
+    of the RNG key chain used by sampling ops, like the reference's separate
+    initializer RNG.
+    """
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def init_array(self, name: str, shape, dtype) -> np.ndarray:
+        from .base import dtype_np
+
+        arr = np.zeros(shape, dtype=np.float32)
+        name = name or ""
+        if name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif "running_mean" in name or "moving_mean" in name:
+            arr[:] = 0.0
+        elif "running_var" in name or "moving_var" in name:
+            arr[:] = 1.0
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        else:
+            self._init_weight(name, arr)
+        return arr.astype(dtype_np(dtype))
+
+    def __call__(self, name, arr):  # legacy API: fills an NDArray
+        out = self.init_array(name, arr.shape, np.float32)
+        arr._set_data(__import__("jax").device_put(out, arr.context.jax_device))
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale: float = 0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma: float = 0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Zero(Constant):
+    def __init__(self):
+        super(Constant, self).__init__()
+        self.value = 0.0
+
+
+@register
+class One(Constant):
+    def __init__(self):
+        super(Constant, self).__init__()
+        self.value = 1.0
+
+
+@register
+class Xavier(Initializer):
+    """Reference: initializer.py Xavier (rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier initializer cannot init {name} with shape {shape}")
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0, scale, arr.shape)
+        else:
+            raise MXNetError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope**2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype=np.float32)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = arr.shape[0] // 4
+        arr[num_hidden : 2 * num_hidden] = self.forget_bias
+
+    def _init_bias(self, name, arr):
+        self._init_weight(name, arr)
+
+
+# string aliases used throughout Gluon layer defaults (reference registers
+# Zero as "zeros", One as "ones")
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+class Mixed:
+    """Pattern-dispatched initializer (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must match")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def init_array(self, name, shape, dtype):
+        for pat, init in self.map:
+            if pat.match(name):
+                return init.init_array(name, shape, dtype)
+        raise MXNetError(f"parameter {name} did not match any pattern")

@@ -1,0 +1,210 @@
+"""KVStore: the data-parallel aggregation facade.
+
+Reference parity: python/mxnet/kvstore.py over src/kvstore/ —
+KVStoreLocal (kvstore_local.h ~L200), CommDevice reduce (comm.h ~L500),
+KVStoreNCCL (kvstore_nccl.h), KVStoreDist (kvstore_dist.h).
+
+TPU-native mapping (SURVEY §2.3/§5.8):
+  * 'local' / 'device' / 'nccl'  -> single-process aggregation across the
+    local device list.  The hand-rolled tree reduce / RCCL rings of the
+    reference are unnecessary: the fused pjit training-step path
+    (mxnet_tpu.parallel) emits XLA ICI collectives; this eager facade sums
+    on the lead device, preserving exact KVStore push/pull semantics.
+  * 'dist_sync' / 'dist_sync_device' -> same API over a multi-host program
+    (jax.distributed); gradients are globally reduced; servers do not exist
+    as processes — the "server-side optimizer" (update_on_kvstore) runs
+    identically on every host, which is numerically equivalent to the
+    reference's sync PS protocol.
+  * 'dist_async' -> unsupported by design: async parameter serving has no
+    SPMD analog (documented divergence).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Union
+
+from .base import MXNetError
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """Key-value store for parameter synchronization."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._store: Dict[Any, Any] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # ------------------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        if self._type.startswith("dist"):
+            import jax
+
+            try:
+                return jax.process_index()
+            except Exception:
+                return 0
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        if self._type.startswith("dist"):
+            import jax
+
+            try:
+                return jax.process_count()
+            except Exception:
+                return 1
+        return 1
+
+    # ------------------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, values = self._key_value(key, value)
+        for k, v in zip(keys, values):
+            vals = _as_list(v)
+            self._store[k] = vals[0].copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = self._key_value(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(_as_list(v))
+            if self._type.startswith("dist") and self.num_workers > 1:
+                merged = self._global_sum(merged)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(self._updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        keys, outs = self._key_value(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for dst in _as_list(o):
+                dst._set_data(self._to_ctx(src, dst.context))
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def broadcast(self, key, value, out, priority: int = 0) -> None:
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
+        # sparse storage is emulated densely (SURVEY §7.3 item 8)
+        self.pull(key, out, priority)
+
+    # ------------------------------------------------------------------
+    def set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        """Install a server-side optimizer (reference: _send_command_to_servers
+        pickles it; here the 'server' is this process — and every host in the
+        dist_sync case, which the sync protocol makes equivalent)."""
+        from . import optimizer as opt_mod
+
+        # round-trip through pickle to mirror the reference's serialization
+        # boundary (catches unpicklable user optimizers early)
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params: Dict) -> None:
+        # DCN/ICI collectives don't need 2-bit compression; accepted for API
+        # compatibility (reference: gradient_compression.cc)
+        self._compression_params = compression_params
+
+    # ------------------------------------------------------------------
+    def barrier(self) -> None:
+        if self._type.startswith("dist"):
+            from .parallel import host_barrier
+
+            host_barrier()
+
+    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False) -> None:
+        if self._updater is None:
+            raise MXNetError("no updater installed")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no updater installed")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------------------------
+    def _key_value(self, key, value):
+        if isinstance(key, (list, tuple)):
+            if value is None:
+                return list(key), [None] * len(key)
+            return list(key), list(value)
+        return [key], [value]
+
+    @staticmethod
+    def _updater_key(k):
+        return int(k) if isinstance(k, str) and k.isdigit() else k
+
+    def _reduce(self, vals: List):
+        """Sum a per-device list on the lead device (CommDevice::Reduce)."""
+        if len(vals) == 1:
+            return vals[0].copy()
+        lead = vals[0].context
+        import jax
+
+        total = vals[0]._data
+        for v in vals[1:]:
+            arr = v._data
+            if v.context != lead:
+                arr = jax.device_put(arr, lead.jax_device)
+            total = total + arr
+        from .ndarray import NDArray
+
+        return NDArray(total, ctx=lead)
+
+    def _global_sum(self, nd):
+        from .parallel import global_allreduce
+
+        return global_allreduce(nd)
+
+    def _to_ctx(self, nd, ctx):
+        import jax
+
+        if nd.context == ctx:
+            return nd._data
+        return jax.device_put(nd._data, ctx.jax_device)
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (reference: kvstore.cc factory ~L30)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    kv_type = name.lower()
+    if kv_type in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                   "device", "nccl"):
+        return KVStore("device" if kv_type != "local" else "local")
+    if kv_type in ("dist_sync", "dist_sync_device", "dist_device_sync"):
+        return KVStore(kv_type)
+    if kv_type == "dist_async":
+        raise MXNetError(
+            "dist_async is not supported on TPU: asynchronous parameter "
+            "serving has no SPMD analog (see SURVEY §2.3); use dist_sync")
+    raise MXNetError(f"unknown KVStore type {name!r}")

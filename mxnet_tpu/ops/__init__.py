@@ -15,3 +15,4 @@ from . import indexing  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401

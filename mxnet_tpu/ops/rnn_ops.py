@@ -1,0 +1,108 @@
+"""Fused multi-layer RNN (reference: src/operator/rnn.{cc,-inl.h} +
+cudnn_rnn-inl.h / MIOpen RNN).
+
+TPU-native: the recurrence is a lax.scan per layer/direction — XLA compiles
+the whole stack into one looped kernel (compiler-friendly control flow; no
+unrolled graph blowup), the TPU analog of the vendor fused RNN.  Gate
+orderings follow the cuDNN/MXNet convention: LSTM [i, f, g, o], GRU [r, z, n].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _step_rnn_relu(x_t, h, wi, wh, bi, bh):
+    return jnp.maximum(x_t @ wi.T + bi + h @ wh.T + bh, 0)
+
+
+def _step_rnn_tanh(x_t, h, wi, wh, bi, bh):
+    return jnp.tanh(x_t @ wi.T + bi + h @ wh.T + bh)
+
+
+def _step_lstm(x_t, h, c, wi, wh, bi, bh):
+    gates = x_t @ wi.T + bi + h @ wh.T + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return new_h, new_c
+
+def _step_gru(x_t, h, wi, wh, bi, bh):
+    gi = x_t @ wi.T + bi
+    gh = h @ wh.T + bh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False):
+    """Run one direction of one layer over time; xs: (T, B, I)."""
+
+    if mode == "lstm":
+        def body(carry, x_t):
+            h, c = carry
+            new_h, new_c = _step_lstm(x_t, h, c, wi, wh, bi, bh)
+            return (new_h, new_c), new_h
+
+        (hT, cT), ys = jax.lax.scan(body, (h0, c0), xs, reverse=reverse)
+        return ys, hT, cT
+
+    step = {"rnn_relu": _step_rnn_relu, "rnn_tanh": _step_rnn_tanh,
+            "gru": _step_gru}[mode]
+
+    def body(h, x_t):
+        new_h = step(x_t, h, wi, wh, bi, bh)
+        return new_h, new_h
+
+    hT, ys = jax.lax.scan(body, h0, xs, reverse=reverse)
+    return ys, hT, None
+
+
+@register("_fused_rnn")
+def _fused_rnn(data, key, state_h, state_c, *weights, mode="lstm",
+               state_size=0, num_layers=1, bidirectional=False, p=0.0,
+               training=False, state_outputs=True):
+    """Multi-layer (bi)directional RNN over TNC data.
+
+    weights: per layer, per direction: i2h_w, h2h_w, i2h_b, h2h_b.
+    state_h/state_c: (num_layers*dirs, B, H).  Returns (out, h_n[, c_n]).
+    """
+    dirs = 2 if bidirectional else 1
+    xs = data
+    idx = 0
+    h_out, c_out = [], []
+    keys = (jax.random.split(key, num_layers)
+            if (training and p > 0.0) else [None] * num_layers)
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(2 if bidirectional else 1):
+            wi, wh, bi, bh = weights[idx * 4: idx * 4 + 4]
+            s = layer * dirs + d
+            h0 = state_h[s]
+            c0 = state_c[s] if mode == "lstm" else None
+            ys, hT, cT = _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh,
+                                     reverse=(d == 1))
+            layer_outs.append(ys)
+            h_out.append(hT)
+            if mode == "lstm":
+                c_out.append(cT)
+            idx += 1
+        xs = (jnp.concatenate(layer_outs, axis=-1) if bidirectional
+              else layer_outs[0])
+        if training and p > 0.0 and layer < num_layers - 1:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(keys[layer], keep, xs.shape)
+            xs = xs * mask.astype(xs.dtype) / keep
+    h_n = jnp.stack(h_out, axis=0)
+    if mode == "lstm":
+        return xs, h_n, jnp.stack(c_out, axis=0)
+    return xs, h_n

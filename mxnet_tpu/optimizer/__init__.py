@@ -1,0 +1,4 @@
+"""Optimizer package (reference: python/mxnet/optimizer/)."""
+from .optimizer import *
+from .optimizer import Optimizer, Updater, get_updater, register, create
+from . import lr_scheduler

@@ -1,0 +1,629 @@
+"""Optimizers.
+
+Reference parity: python/mxnet/optimizer/optimizer.py (Optimizer registry
+~L40, SGD w/ momentum + multi-precision ~L700, Adam, LAMB, AdaGrad, RMSProp,
+Updater/get_updater ~L1700) dispatching to the fused update ops in
+ops/optimizer_ops.py (reference src/operator/optimizer_op.*).
+
+Multi-precision: bf16/fp16 weights keep an fp32 master copy in the state,
+updated by the mp_* fused ops — the TPU-normal bf16 training recipe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "Adamax", "Nadam", "AdaGrad",
+           "AdaDelta", "RMSProp", "Ftrl", "Signum", "LAMB", "Updater",
+           "get_updater", "register", "create"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    name = cls.__name__.lower()
+    _REGISTRY[name] = cls
+    return cls
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    try:
+        return _REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise MXNetError(f"unknown optimizer {name!r}") from None
+
+
+class Optimizer:
+    """Base optimizer (reference ~L40)."""
+
+    opt_registry = _REGISTRY
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.multi_precision = multi_precision
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+
+    # -- registry-style API -------------------------------------------------
+    @staticmethod
+    def register(cls):
+        return register(cls)
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    # -- bookkeeping --------------------------------------------------------
+    def _update_count(self, index) -> None:
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index) -> float:
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr: float) -> None:
+        if self.lr_scheduler is not None:
+            raise MXNetError(
+                "LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr: float):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult: Dict) -> None:
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict) -> None:
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (np.float16,) or (
+                self.multi_precision and np.dtype(weight._data.dtype).name
+                in ("float16", "bfloat16")):
+            from ..ndarray import NDArray
+
+            master = NDArray(weight._data.astype(np.float32),
+                             ctx=weight.context)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and len(state) == 2 \
+                and getattr(state[0], "shape", None) == weight.shape:
+            self._update_mp(index, weight, grad, state)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _update_mp(self, index, weight, grad, state):
+        # generic master-weight path: update master fp32 copy, cast down
+        master, inner = state
+        self.update(index, master, grad, inner)
+        weight._set_data(master._data.astype(weight._data.dtype))
+
+    def _common_kwargs(self, index) -> Dict[str, Any]:
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        kw["clip_gradient"] = (self.clip_gradient
+                               if self.clip_gradient is not None else -1.0)
+        return kw
+
+
+def _zeros_like(weight):
+    import jax.numpy as jnp
+
+    from ..ndarray import NDArray
+
+    return NDArray(jnp.zeros_like(weight._data), ctx=weight.context)
+
+
+def _zeros_like32(weight):
+    import jax.numpy as jnp
+
+    from ..ndarray import NDArray
+
+    return NDArray(jnp.zeros(weight.shape, jnp.float32), ctx=weight.context)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision (reference ~L700)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _zeros_like32(weight)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _reg.invoke_by_name("sgd_update", [weight, grad], out=weight, **kw)
+        else:
+            new_w, new_mom = _reg.invoke_by_name(
+                "sgd_mom_update", [weight, grad, state],
+                momentum=self.momentum, **kw)
+            weight._set_data(new_w._data)
+            state._set_data(new_mom._data)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = isinstance(state, tuple) and len(state) == 2 and \
+            getattr(state[0], "shape", None) == weight.shape
+        if not use_mp:
+            return self.update(index, weight, grad, state)
+        master, mom = state
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if mom is None:
+            new_w, new32 = _reg.invoke_by_name(
+                "mp_sgd_update", [weight, grad, master], **kw)
+            weight._set_data(new_w._data)
+            master._set_data(new32._data)
+        else:
+            new_w, new_mom, new32 = _reg.invoke_by_name(
+                "mp_sgd_mom_update", [weight, grad, mom, master],
+                momentum=self.momentum, **kw)
+            weight._set_data(new_w._data)
+            mom._set_data(new_mom._data)
+            master._set_data(new32._data)
+
+    def create_state_multi_precision(self, index, weight):
+        name = np.dtype(weight._data.dtype).name
+        if self.multi_precision and name in ("float16", "bfloat16"):
+            from ..ndarray import NDArray
+
+            master = NDArray(weight._data.astype(np.float32), ctx=weight.context)
+            mom = _zeros_like32(weight) if self.momentum != 0.0 else None
+            return (master, mom)
+        return self.create_state(index, weight)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _zeros_like32(weight) if self.momentum != 0.0 else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _reg.invoke_by_name("sgd_update", [weight, grad], out=weight, **kw)
+        else:
+            new_w, new_mom = _reg.invoke_by_name(
+                "nag_mom_update", [weight, grad, state],
+                momentum=self.momentum, **kw)
+            weight._set_data(new_w._data)
+            state._set_data(new_mom._data)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr (reference optimizer.py Adam.update)
+        coef1 = 1.0 - self.beta1**t
+        coef2 = 1.0 - self.beta2**t
+        kw["lr"] *= math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = _reg.invoke_by_name(
+            "adam_update", [weight, grad, mean, var], beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, **kw)
+        weight._set_data(new_w._data)
+        mean._set_data(new_mean._data)
+        var._set_data(new_var._data)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1**t)
+        wd = self._get_wd(index)
+        mean, u = state
+
+        def fn(w, g, m, v):
+            g32 = g.astype(jnp.float32) * self.rescale_grad
+            if self.clip_gradient is not None:
+                g32 = jnp.clip(g32, -self.clip_gradient, self.clip_gradient)
+            g32 = g32 + wd * w.astype(jnp.float32)
+            new_m = self.beta1 * m + (1 - self.beta1) * g32
+            new_u = jnp.maximum(self.beta2 * v, jnp.abs(g32))
+            new_w = w.astype(jnp.float32) - lr * new_m / (new_u + 1e-8)
+            return new_w.astype(w.dtype), new_m, new_u
+
+        new_w, new_m, new_u = _reg.invoke_fn(fn, [weight, grad, mean, u])
+        weight._set_data(new_w._data)
+        mean._set_data(new_m._data)
+        u._set_data(new_u._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96**(t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96**((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        mean, var = state
+
+        def fn(w, g, m, v):
+            g32 = g.astype(jnp.float32) * self.rescale_grad
+            if self.clip_gradient is not None:
+                g32 = jnp.clip(g32, -self.clip_gradient, self.clip_gradient)
+            g32 = g32 + wd * w.astype(jnp.float32)
+            g_prime = g32 / (1.0 - self.m_schedule)
+            new_m = self.beta1 * m + (1.0 - self.beta1) * g32
+            m_prime = new_m / (1.0 - m_schedule_next)
+            new_v = self.beta2 * v + (1.0 - self.beta2) * jnp.square(g32)
+            v_prime = new_v / (1.0 - self.beta2**t)
+            m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+            new_w = w.astype(jnp.float32) - lr * m_bar / (
+                jnp.sqrt(v_prime) + self.epsilon)
+            return new_w.astype(w.dtype), new_m, new_v
+
+        new_w, new_m, new_v = _reg.invoke_fn(fn, [weight, grad, mean, var])
+        weight._set_data(new_w._data)
+        mean._set_data(new_m._data)
+        var._set_data(new_v._data)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like32(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        new_w, new_hist = _reg.invoke_by_name(
+            "adagrad_update", [weight, grad, state],
+            epsilon=self.float_stable_eps, **kw)
+        weight._set_data(new_w._data)
+        state._set_data(new_hist._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_delta = state
+        kw = self._common_kwargs(index)
+        kw.pop("lr")
+        new_w, new_g, new_d = _reg.invoke_by_name(
+            "adadelta_update", [weight, grad, acc_g, acc_delta], rho=self.rho,
+            epsilon=self.epsilon, **kw)
+        weight._set_data(new_w._data)
+        acc_g._set_data(new_g._data)
+        acc_delta._set_data(new_d._data)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like32(weight), _zeros_like32(weight),
+                    _zeros_like32(weight))
+        return _zeros_like32(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        cw = self.clip_weights if self.clip_weights is not None else -1.0
+        if self.centered:
+            n, g_buf, delta = state
+            new_w, new_n, new_g, new_d = _reg.invoke_by_name(
+                "rmspropalex_update", [weight, grad, n, g_buf, delta],
+                gamma1=self.gamma1, gamma2=self.gamma2, epsilon=self.epsilon,
+                clip_weights=cw, **kw)
+            weight._set_data(new_w._data)
+            n._set_data(new_n._data)
+            g_buf._set_data(new_g._data)
+            delta._set_data(new_d._data)
+        else:
+            new_w, new_n = _reg.invoke_by_name(
+                "rmsprop_update", [weight, grad, state], gamma1=self.gamma1,
+                epsilon=self.epsilon, clip_weights=cw, **kw)
+            weight._set_data(new_w._data)
+            state._set_data(new_n._data)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        kw = self._common_kwargs(index)
+        new_w, new_z, new_n = _reg.invoke_by_name(
+            "ftrl_update", [weight, grad, z, n], lamda1=self.lamda1,
+            beta=self.beta, **kw)
+        weight._set_data(new_w._data)
+        z._set_data(new_z._data)
+        n._set_data(new_n._data)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return _zeros_like32(weight) if self.momentum != 0.0 else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            new_w = _reg.invoke_by_name("signsgd_update", [weight, grad], **kw)
+            weight._set_data(new_w._data)
+        else:
+            new_w, new_mom = _reg.invoke_by_name(
+                "signum_update", [weight, grad, state], momentum=self.momentum,
+                wd_lh=self.wd_lh, **kw)
+            weight._set_data(new_w._data)
+            state._set_data(new_mom._data)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference: optimizer.py
+    LAMB; phases map to lamb_update_phase1/2 fused ops)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like32(weight), _zeros_like32(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = self._common_kwargs(index)
+        lr = kw.pop("lr")
+        wd = kw.pop("wd")
+        g_update, new_mean, new_var = _reg.invoke_by_name(
+            "lamb_update_phase1", [weight, grad, mean, var], beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=wd, **kw)
+        r1 = _reg.invoke_fn(
+            lambda w: jnp.linalg.norm(w.astype(jnp.float32)).reshape(1),
+            [weight])
+        r2 = _reg.invoke_fn(
+            lambda g: jnp.linalg.norm(g).reshape(1), [g_update])
+        new_w = _reg.invoke_by_name(
+            "lamb_update_phase2", [weight, g_update, r1, r2], lr=lr,
+            lower_bound=self.lower_bound if self.lower_bound is not None else -1.0,
+            upper_bound=self.upper_bound if self.upper_bound is not None else -1.0)
+        weight._set_data(new_w._data)
+        mean._set_data(new_mean._data)
+        var._set_data(new_var._data)
+
+
+class Updater:
+    """KVStore server-side updater (reference: get_updater ~L1700)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced.get(index, True):
+            # state loaded via set_states before this index was ever updated:
+            # materialize device state and fill it from the numpy snapshot
+            # (reference: Updater sync on first use)
+            snapshot = self.states[index]
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            _numpy_to_states(self.states[index], snapshot)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        state = {}
+        for idx, s in self.states.items():
+            state[idx] = _states_to_numpy(s)
+        return pickle.dumps((state, self.optimizer) if dump_optimizer else state)
+
+    def set_states(self, states):
+        import pickle
+
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(
+                data[1], Optimizer):
+            state, self.optimizer = data
+        else:
+            state = data
+        self._numpy_states = state
+        for idx, snp in state.items():
+            if idx in self.states:
+                _numpy_to_states(self.states[idx], snp)
+            else:
+                self.states[idx] = snp
+                self.states_synced[idx] = False
+
+
+def _states_to_numpy(s):
+    from ..ndarray import NDArray
+
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s.asnumpy()
+    if isinstance(s, (list, tuple)):
+        return tuple(_states_to_numpy(x) for x in s)
+    return s
+
+
+def _numpy_to_states(s, snp):
+    import jax
+
+    from ..ndarray import NDArray
+
+    if s is None or snp is None:
+        return
+    if isinstance(s, NDArray):
+        s._set_data(jax.device_put(snp.astype(np.dtype(s._data.dtype)),
+                                   s.context.jax_device))
+        return
+    if isinstance(s, (list, tuple)):
+        for x, xnp in zip(s, snp):
+            _numpy_to_states(x, xnp)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,12 @@
+"""Parallelism: meshes, collectives, and fused distributed training steps.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (src/kvstore/comm.h device reduce, kvstore_nccl.h RCCL
+rings, ps-lite parameter server): parallelism is expressed as shardings over
+a jax.sharding.Mesh and compiled into XLA programs whose collectives ride
+ICI/DCN (SURVEY §2.4, §5.8).
+"""
+from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
+                   global_allreduce)
+from .data_parallel import DataParallelStep, make_train_step
+from . import sharding

@@ -1,0 +1,261 @@
+"""Fused distributed training step: forward + backward + optimizer in ONE
+XLA program over a device mesh.
+
+This is the performance path that replaces the reference's per-batch chain
+of engine pushes (CachedOp forward -> backward -> kvstore push/reduce ->
+optimizer kernels -> broadcast; SURVEY §3.3).  Here the whole chain is a
+single jit: XLA overlaps the gradient reduce-scatter/all-reduce with the
+backward pass over ICI and fuses the optimizer update into the gradient
+buffers — strictly less launch overhead and less HBM traffic than the
+eager path.
+
+Works with any Gluon HybridBlock: its forward is traced into the step
+function via the same parameter-substitution trace the CachedOp uses.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .sharding import ShardingRules, replicated, shard_batch
+
+__all__ = ["DataParallelStep", "make_train_step"]
+
+
+def _block_apply_fn(block, ctx, train: bool):
+    """Build a pure fn(params_dict, key, *inputs) -> outputs from a Gluon
+    block (same mechanism as gluon.block.CachedOp)."""
+    from .. import autograd
+    from .. import random as _random
+    from ..gluon.parameter import begin_trace, end_trace
+    from ..ndarray import NDArray
+
+    param_items = list(block.collect_params().items())
+    name_of = {p: name for name, p in param_items}
+
+    def fn(param_arrays: Dict[str, Any], key, *input_arrays):
+        param_map = {p: NDArray(param_arrays[name], ctx=ctx)
+                     for name, p in param_items}
+        nd_inputs = [NDArray(a, ctx=ctx) for a in input_arrays]
+        prev_trace = begin_trace(param_map, ctx)
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(train)
+        prev_key = _random.set_trace_key_provider(_random._TraceKeyProvider(key))
+        try:
+            out = block.forward(*nd_inputs)
+        finally:
+            state = end_trace(prev_trace)
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+            _random.set_trace_key_provider(prev_key)
+        aux = [(name_of[p], v._data) for p, v in state["aux"]]
+        if isinstance(out, (list, tuple)):
+            return [o._data for o in out], aux
+        return out._data, aux
+
+    return fn, param_items
+
+
+def _sgd_tree_update(params, grads, momenta, lr, momentum, wd, rescale, mults):
+    import jax.numpy as jnp
+
+    new_params, new_momenta = {}, {}
+    for name, w in params.items():
+        lr_mult, wd_mult = mults.get(name, (1.0, 1.0))
+        if lr_mult is None:  # frozen (grad_req='null'): leave untouched
+            new_params[name] = w
+            new_momenta[name] = momenta[name]
+            continue
+        g = (grads[name].astype(jnp.float32) * rescale
+             + wd * wd_mult * w.astype(jnp.float32))
+        m = momentum * momenta[name] - lr * lr_mult * g
+        new_params[name] = (w.astype(jnp.float32) + m).astype(w.dtype)
+        new_momenta[name] = m
+    return new_params, new_momenta
+
+
+def _adam_tree_update(params, grads, state, lr, beta1, beta2, eps, wd, rescale,
+                      mults):
+    import jax.numpy as jnp
+
+    means, vars_, t = state
+    t = t + 1
+    corr = jnp.sqrt(1 - beta2**t) / (1 - beta1**t)
+    new_p, new_m, new_v = {}, {}, {}
+    for name, w in params.items():
+        lr_mult, wd_mult = mults.get(name, (1.0, 1.0))
+        if lr_mult is None:  # frozen
+            new_p[name] = w
+            new_m[name] = means[name]
+            new_v[name] = vars_[name]
+            continue
+        g = (grads[name].astype(jnp.float32) * rescale
+             + wd * wd_mult * w.astype(jnp.float32))
+        m = beta1 * means[name] + (1 - beta1) * g
+        v = beta2 * vars_[name] + (1 - beta2) * jnp.square(g)
+        new_p[name] = (w.astype(jnp.float32)
+                       - lr * lr_mult * corr * m / (jnp.sqrt(v) + eps)).astype(w.dtype)
+        new_m[name] = m
+        new_v[name] = v
+    return new_p, (new_m, new_v, t)
+
+
+class DataParallelStep:
+    """Compiled train step for a Gluon block over a mesh.
+
+    Parameters live as sharded jax arrays owned by this object (master fp32
+    optionally); sync_to_block() writes them back into the Gluon parameters.
+    """
+
+    def __init__(self, block, loss_fn: Callable, mesh=None,
+                 optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
+                 rules: Optional[ShardingRules] = None,
+                 batch_axes: Sequence[str] = ("dp", "sp"),
+                 donate: bool = True):
+        import jax
+
+        from ..context import current_context
+
+        if mesh is None:
+            from .mesh import local_mesh
+
+            mesh = local_mesh()
+        self.mesh = mesh
+        self.block = block
+        self.loss_fn = loss_fn
+        self.rules = rules or ShardingRules()
+        self._batch_axes = tuple(batch_axes)
+        opt_params = dict(optimizer_params or {})
+        self._lr = opt_params.get("learning_rate", 0.01)
+        self._momentum = opt_params.get("momentum", 0.9)
+        self._wd = opt_params.get("wd", 0.0)
+        self._beta1 = opt_params.get("beta1", 0.9)
+        self._beta2 = opt_params.get("beta2", 0.999)
+        self._eps = opt_params.get("epsilon", 1e-8)
+        self._rescale = opt_params.get("rescale_grad", 1.0)
+        self._optimizer = optimizer
+        self._donate = donate
+
+        ctx = current_context()
+        self._ctx = ctx
+        self._apply, self._param_items = _block_apply_fn(block, ctx, train=True)
+        # frozen params (grad_req='null') are marked with lr_mult=None and
+        # skipped by the tree updates; others carry their lr/wd multipliers
+        self._mults = {
+            n: ((None, None) if p.grad_req == "null"
+                else (p.lr_mult, p.wd_mult))
+            for n, p in self._param_items
+        }
+
+        # gather initial param values; shard per rules
+        names = [n for n, _ in self._param_items]
+        shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
+        self._shardings = self.rules.shardings(mesh, shapes)
+        self.params = {
+            n: jax.device_put(p.data()._data, self._shardings[n])
+            for n, p in self._param_items
+        }
+        if optimizer == "sgd":
+            self.opt_state = {
+                n: jax.device_put(
+                    jax.numpy.zeros(shapes[n], jax.numpy.float32),
+                    self._shardings[n])
+                for n in names
+            }
+        elif optimizer == "adam":
+            z = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
+                                   self._shardings[n]) for n in names}
+            z2 = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
+                                    self._shardings[n]) for n in names}
+            self.opt_state = (z, z2, jax.numpy.zeros((), jax.numpy.int32))
+        else:
+            raise MXNetError(f"fused step supports sgd/adam, got {optimizer}")
+        self._jitted = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        apply_fn = self._apply
+        loss_fn = self.loss_fn
+        opt = self._optimizer
+        lr, momentum, wd, rescale = (self._lr, self._momentum, self._wd,
+                                     self._rescale)
+        beta1, beta2, eps = self._beta1, self._beta2, self._eps
+        mults = self._mults
+
+        ctx = self._ctx
+
+        def loss_of(params, key, data, label):
+            from ..ndarray import NDArray
+
+            out, aux = apply_fn(params, key, data)
+            out_nd = (NDArray(out, ctx=ctx) if not isinstance(out, list)
+                      else [NDArray(o, ctx=ctx) for o in out])
+            loss = loss_fn(out_nd, NDArray(label, ctx=ctx))
+            larr = loss._data if isinstance(loss, NDArray) else loss
+            return jnp.mean(larr.astype(jnp.float32)), aux
+
+        def step(params, opt_state, key, data, label):
+            (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params, key, data, label)
+            if opt == "sgd":
+                new_params, new_state = _sgd_tree_update(
+                    params, grads, opt_state, lr, momentum, wd, rescale, mults)
+            else:
+                new_params, new_state = _adam_tree_update(
+                    params, grads, opt_state, lr, beta1, beta2, eps, wd,
+                    rescale, mults)
+            # aux (BN stats): already averaged over the global batch by XLA
+            for name, val in aux:
+                new_params[name] = val.astype(new_params[name].dtype)
+            return new_params, new_state, loss
+
+        repl = replicated(self.mesh)
+        donate = (0, 1) if self._donate else ()
+        self._jitted = jax.jit(
+            step,
+            out_shardings=(self._shardings, None, repl),
+            donate_argnums=donate,
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, data, label):
+        """One fused training step; returns the (host) scalar loss array."""
+        import jax
+
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        if self._jitted is None:
+            self._build()
+        data_arr = data._data if isinstance(data, NDArray) else data
+        label_arr = label._data if isinstance(label, NDArray) else label
+        dsh = shard_batch(self.mesh, self._batch_axes, np.ndim(data_arr))
+        lsh = shard_batch(self.mesh, self._batch_axes, np.ndim(label_arr))
+        data_arr = jax.device_put(data_arr, dsh)
+        label_arr = jax.device_put(label_arr, lsh)
+        key = _random.next_key()
+        self.params, self.opt_state, loss = self._jitted(
+            self.params, self.opt_state, key, data_arr, label_arr)
+        self._step_count += 1
+        return loss
+
+    # ------------------------------------------------------------------
+    def sync_to_block(self) -> None:
+        """Write the sharded training state back into the Gluon parameters."""
+        import jax
+
+        for name, p in self._param_items:
+            host = np.asarray(jax.device_get(self.params[name]))
+            p.set_data(host)
+
+
+def make_train_step(block, loss_fn, mesh=None, **kwargs) -> DataParallelStep:
+    return DataParallelStep(block, loss_fn, mesh=mesh, **kwargs)

@@ -1,0 +1,97 @@
+"""Device meshes and host-level collectives.
+
+Reference parity: the topology-aware comm layer (src/kvstore/gpu_topology.h
+builds reduction trees from link matrices).  On TPU the topology belongs to
+XLA: we only choose the logical mesh axes; ICI routing is the compiler's job.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "local_mesh", "device_mesh", "host_barrier",
+           "global_allreduce"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def device_mesh(axis_names: Sequence[str], shape: Optional[Sequence[int]] = None,
+                devices=None):
+    """Build a jax Mesh with named axes over `devices` (default: all)."""
+    jax = _jax()
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != n:
+        raise MXNetError(
+            f"mesh shape {tuple(shape)} does not cover {n} devices")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def make_mesh(dp: int = 0, tp: int = 1, pp: int = 1, sp: int = 1, ep: int = 1,
+              devices=None):
+    """Mesh factory over the standard parallelism axes.
+
+    Axes with size 1 are still present (so shardings can name them); dp=0
+    means "whatever is left".  Axis order (dp, pp, sp, tp, ep) puts tensor
+    parallelism innermost — adjacent devices on the ICI ring — which is the
+    bandwidth-optimal layout for TP collectives (scaling-book recipe).
+    """
+    jax = _jax()
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = tp * pp * sp * ep
+    if dp in (0, None):
+        if n % fixed != 0:
+            raise MXNetError(f"{n} devices not divisible by tp*pp*sp*ep={fixed}")
+        dp = n // fixed
+    return device_mesh(("dp", "pp", "sp", "tp", "ep"),
+                       (dp, pp, sp, tp, ep), devices)
+
+
+def local_mesh(axis_name: str = "dp", devices=None):
+    """1-D data-parallel mesh over local devices (KVStore('device') shape)."""
+    jax = _jax()
+    if devices is None:
+        devices = jax.local_devices()
+    return device_mesh((axis_name,), (len(devices),), devices)
+
+
+def host_barrier() -> None:
+    """Block until all hosts reach this point (reference: kv._barrier via
+    ps-lite scheduler; here: a tiny global psum)."""
+    jax = _jax()
+    if jax.process_count() == 1:
+        return
+    import jax.numpy as jnp
+
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+
+
+def global_allreduce(nd):
+    """Sum an NDArray across all hosts (DCN allreduce for dist_sync kvstore)."""
+    jax = _jax()
+    if jax.process_count() == 1:
+        return nd
+    from jax.experimental import multihost_utils
+
+    from ..ndarray import NDArray
+
+    summed = multihost_utils.process_allgather(nd._data).sum(axis=0)
+    return NDArray(jax.device_put(summed, nd.context.jax_device),
+                   ctx=nd.context)

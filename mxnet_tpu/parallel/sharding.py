@@ -1,0 +1,66 @@
+"""Sharding rules: parameter-name patterns -> PartitionSpec.
+
+The reference's model parallelism is manual device placement (group2ctx ->
+nnvm PlaceDevice pass); the TPU-native expression is a NamedSharding per
+parameter over the mesh axes, with XLA inserting the collectives.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ShardingRules", "replicated", "shard_batch"]
+
+
+def _P(*args):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*args)
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table applied to parameter names.
+
+    Example (transformer TP over axis 'tp')::
+
+        rules = ShardingRules([
+            (r".*attention.*proj\\.weight", ("tp", None)),   # row-parallel
+            (r".*(query|key|value)\\.weight", (None, "tp")), # col-parallel
+            (r".*ffn_1\\.weight", (None, "tp")),
+            (r".*ffn_2\\.weight", ("tp", None)),
+        ])
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, Sequence]]] = None):
+        self._rules = [(re.compile(pat), tuple(spec)) for pat, spec in (rules or [])]
+
+    def spec_for(self, name: str, ndim: int):
+        for pat, spec in self._rules:
+            if pat.match(name):
+                spec = tuple(spec)[:ndim]
+                spec = spec + (None,) * (ndim - len(spec))
+                return _P(*spec)
+        return _P()  # replicated
+
+    def shardings(self, mesh, named_shapes: Dict[str, Tuple[int, ...]]):
+        from jax.sharding import NamedSharding
+
+        return {
+            name: NamedSharding(mesh, self.spec_for(name, len(shape)))
+            for name, shape in named_shapes.items()
+        }
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, _P())
+
+
+def shard_batch(mesh, axes=("dp",), ndim=2):
+    """Sharding for a batch tensor: batch axis split over data axes."""
+    from jax.sharding import NamedSharding
+
+    axis = tuple(a for a in axes if a in mesh.axis_names)
+    spec = (axis if len(axis) > 1 else (axis[0] if axis else None),)
+    return NamedSharding(mesh, _P(*spec, *([None] * (ndim - 1))))

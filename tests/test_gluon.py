@@ -1,0 +1,278 @@
+"""Gluon Block/HybridBlock/Trainer tests (reference model:
+tests/python/unittest/test_gluon.py — the key behavioral spec per SURVEY §4.2)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def make_lenet():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(6, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(16, kernel_size=3, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(10))
+    return net
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3))
+    y = net(x)
+    assert y.shape == (2, 4)
+    assert net.weight.shape == (4, 3)
+    assert net.bias.shape == (4,)
+
+
+def test_parameter_api():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    params = net.collect_params()
+    assert any(k.endswith("weight") for k in params.keys())
+    w = net.weight.data()
+    assert w.shape == (2, 3)
+    net.weight.set_data(nd.ones((2, 3)))
+    np.testing.assert_allclose(net.weight.data().asnumpy(), np.ones((2, 3)))
+    g = net.weight.grad()
+    assert g.shape == (2, 3)
+
+
+def test_sequential_forward():
+    net = make_lenet()
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 1, 28, 28))
+    y = net(x)
+    assert y.shape == (2, 10)
+
+
+def test_hybridize_matches_eager():
+    net = make_lenet()
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 1, 28, 28))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, y_hybrid, rtol=2e-5, atol=2e-5)
+    # second call goes through the cached executable
+    y2 = net(x).asnumpy()
+    np.testing.assert_allclose(y_hybrid, y2, rtol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 5))
+
+    def loss_grads():
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return [p.grad().asnumpy().copy()
+                for p in net.collect_params().values()]
+
+    g_eager = loss_grads()
+    net.hybridize()
+    g_hybrid = loss_grads()
+    for a, b in zip(g_eager, g_hybrid):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats_update():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.random.normal(loc=5.0, scale=2.0, shape=(8, 3, 4, 4))
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1), "running mean should move in training"
+    # inference mode: stats not updated, used for normalization
+    y = net(x)
+    rm2 = net.running_mean.data().asnumpy()
+    np.testing.assert_allclose(rm1, rm2)
+
+
+def test_batchnorm_running_stats_update_hybridized():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = nd.random.normal(loc=5.0, scale=2.0, shape=(8, 3, 4, 4))
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1), \
+        "hybridized BN must still update running stats (aux collector)"
+
+
+def test_dropout_hybridized_differs_per_call():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((100,))
+    with autograd.record():
+        y1 = net(x).asnumpy()
+        y2 = net(x).asnumpy()
+    assert not np.allclose(y1, y2), "different RNG keys per call"
+    # eval mode: identity
+    y3 = net(x).asnumpy()
+    np.testing.assert_allclose(y3, np.ones(100))
+
+
+def test_trainer_convergence():
+    """Convergence smoke (reference: tests/python/train/) on synthetic
+    separable data with a small MLP."""
+    np.random.seed(0)
+    n = 256
+    x_np = np.random.randn(n, 10).astype(np.float32)
+    w_true = np.random.randn(10, 3).astype(np.float32)
+    y_np = np.argmax(x_np @ w_true, axis=1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = nd.array(x_np), nd.array(y_np)
+
+    for epoch in range(60):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(n)
+    acc = mx.metric.Accuracy()
+    acc.update(y, net(x))
+    assert acc.get()[1] > 0.95, f"accuracy {acc.get()[1]} too low"
+
+
+def test_trainer_adam_and_state_io(tmp_path):
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.random.uniform(shape=(8, 4))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(8)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_save_load_parameters(tmp_path):
+    net = make_lenet()
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 1, 28, 28))
+    y0 = net(x).asnumpy()
+    f = str(tmp_path / "lenet.params")
+    net.save_parameters(f)
+
+    net2 = make_lenet()
+    net2.load_parameters(f)
+    y1 = net2(x).asnumpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-6)
+
+
+def test_constant_and_grad_req():
+    class Scaled(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", np.array([2.0], np.float32))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Scaled()
+    net.initialize()
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [6.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_lstm_layer():
+    lstm = gluon.rnn.LSTM(16, num_layers=2)
+    lstm.initialize()
+    x = nd.random.uniform(shape=(5, 3, 8))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 3, 16)
+    states = lstm.begin_state(3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_gru_bidirectional():
+    gru = gluon.rnn.GRU(8, num_layers=1, bidirectional=True, layout="NTC")
+    gru.initialize()
+    x = nd.random.uniform(shape=(2, 7, 4))
+    out = gru(x)
+    assert out.shape == (2, 7, 16)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(10)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outputs.shape == (2, 5, 10)
+    assert len(states) == 2
+
+
+def test_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    xs = np.random.randn(20, 3).astype(np.float32)
+    ys = np.arange(20).astype(np.float32)
+    ds = ArrayDataset(xs, ys)
+    loader = DataLoader(ds, batch_size=6, shuffle=True, last_batch="keep")
+    seen = 0
+    for data, label in loader:
+        assert data.shape[1] == 3
+        seen += data.shape[0]
+    assert seen == 20
+
+
+def test_loss_functions():
+    pred = nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = nd.array([2, 0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    expected = -np.log(np.exp(3) / np.exp([1, 2, 3]).sum())
+    np.testing.assert_allclose(l.asnumpy(), [expected, expected], rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l2.asnumpy(), [0.5, 2.0])  # w/2 * (p-l)^2
+
+
+def test_metrics():
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4]]))
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.3, 0.1, 0.2]]))
+    assert topk.get()[1] == 1.0
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.MAE())
+    names, values = comp.get()
+    assert len(names) == 2
