@@ -1,0 +1,83 @@
+"""Headline benchmark: ResNet-50 v1b ImageNet-shape training throughput
+(images/sec/chip), bf16, fused forward+backward+SGD step — BASELINE config 2.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: MXNet-CUDA ResNet-50 fp16 on V100 ~1450 img/s/GPU (BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _setup_platform():
+    # prefer the real TPU when the axon relay is configured
+    if "JAX_PLATFORMS" not in os.environ and os.path.isdir("/root/.axon_site"):
+        os.environ["PYTHONPATH"] = "/root/.axon_site"
+        os.environ["JAX_PLATFORMS"] = "axon"
+        sys.path.insert(0, "/root/.axon_site")
+
+
+def main():
+    _setup_platform()
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    res = int(os.environ.get("BENCH_RES", 224 if on_tpu else 64))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+
+    mx.random.seed(0)
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    mx.context.Context._default_ctx.value = ctx
+
+    net = resnet50_v1b()
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16" if on_tpu else "float32")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = DataParallelStep(
+        net, loss_fn, mesh=local_mesh(devices=[ctx.jax_device]),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+    x = np.random.rand(batch, 3, res, res).astype(
+        "float32")
+    y = np.random.randint(0, 1000, batch).astype("float32")
+    if on_tpu:
+        import ml_dtypes
+
+        x = x.astype(ml_dtypes.bfloat16)
+    xb, yb = nd.array(x, ctx=ctx, dtype=x.dtype), nd.array(y, ctx=ctx)
+
+    # warmup (compile)
+    loss = step.step(xb, yb)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step(xb, yb)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    baseline = 1450.0  # MXNet-CUDA V100 fp16 (BASELINE.md)
+    result = {
+        "metric": "resnet50_v1b_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / baseline, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,17 @@ from .kvstore import KVStore
 from . import recordio
 from . import gluon
 from . import parallel
+from . import io
+from . import image
+from . import callback
+from . import model
+from . import profiler
+from . import runtime
+from . import util
+from .util import is_np_array
+from . import test_utils
+from . import contrib
+from . import models
 
 
 def waitall():

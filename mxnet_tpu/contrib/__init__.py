@@ -1,0 +1,3 @@
+"""Contrib (reference: python/mxnet/contrib/ — amp, quantization, onnx)."""
+from . import amp
+from . import quantization

@@ -1,0 +1,137 @@
+"""AMP implementation (see package docstring for the TPU policy)."""
+from __future__ import annotations
+
+import contextlib
+import logging
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from ...base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "convert_model", "DynamicLossScaler"]
+
+_amp_state = {
+    "initialized": False,
+    "target_dtype": None,
+    "loss_scaler": None,
+}
+
+
+class DynamicLossScaler:
+    """Dynamic loss scaling for fp16 (reference ~L400).  Unused for bf16."""
+
+    def __init__(self, init_scale=2.0**16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        for param in params:
+            if param.grad_req == "null" or param._grad is None:
+                continue
+            for g in param.list_grad():
+                arr = g.asnumpy()
+                if not np.isfinite(arr).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable mixed precision (reference: amp.init ~L200).
+
+    On TPU the default target is bfloat16 (fp16 accepted for compat);
+    subsequently created/converted blocks run their compute in the target
+    dtype with fp32 accumulation for matmul/conv.
+    """
+    if target_dtype in ("float16", np.float16):
+        target_dtype = "float16"
+    elif target_dtype not in ("bfloat16",):
+        raise MXNetError(f"AMP target_dtype must be bfloat16 or float16, "
+                         f"got {target_dtype}")
+    _amp_state["initialized"] = True
+    _amp_state["target_dtype"] = target_dtype
+    if target_dtype == "float16":
+        _amp_state["loss_scaler"] = DynamicLossScaler()
+    else:
+        _amp_state["loss_scaler"] = None  # bf16: full fp32 exponent range
+    logging.info("AMP enabled with target dtype %s", target_dtype)
+
+
+def init_trainer(trainer) -> None:
+    """Attach AMP to a Trainer: turns on fp32 master weights
+    (multi-precision optimizer path, reference mp_* ops)."""
+    if not _amp_state["initialized"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    trainer._optimizer.multi_precision = True
+    trainer._amp_loss_scaler = _amp_state["loss_scaler"]
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss for backward (reference: amp.scale_loss).
+
+    bf16: identity (no scaling needed — kept so scripts run unchanged).
+    fp16: multiplies by the dynamic scale; Trainer.step's rescale then
+    divides it back out.
+    """
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    trainer._scale = 1.0 / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+    overflow = scaler.has_overflow(trainer._params)
+    scaler.update_scale(overflow)
+    if overflow:
+        for param in trainer._params:
+            param.zero_grad()
+
+
+def unscale(trainer) -> None:
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for param in trainer._params:
+        if param.grad_req != "null" and param._grad is not None:
+            for g in param.list_grad():
+                g._set_data(g._data * inv)
+
+
+def convert_hybrid_block(block, target_dtype=None, target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, ctx=None,
+                         cast_optional_params=False):
+    """Cast a HybridBlock's parameters/compute to the AMP dtype, keeping
+    normalization statistics in fp32 (handled inside the norm ops, which
+    compute moments in fp32 regardless of input dtype)."""
+    dtype = target_dtype or _amp_state["target_dtype"] or "bfloat16"
+    block.cast(dtype)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype=None, **kwargs):
+    from ...base import dtype_np
+
+    dtype = dtype_np(target_dtype or _amp_state["target_dtype"] or "bfloat16")
+    new_args = {k: v.astype(dtype) for k, v in arg_params.items()}
+    return sym, new_args, dict(aux_params)

@@ -1,0 +1,25 @@
+"""INT8 quantization (reference: python/mxnet/contrib/quantization.py over
+src/operator/quantization/ — quantize_model, calibration).
+
+TPU status: XLA:TPU serves int8 via native int8 matmul lowering; the
+calibration machinery (entropy/KL thresholds, reference calibrate.cc ~L100)
+ports naturally but is out of the BASELINE acceptance surface.  The API is
+present and raises with a clear message until the int8 path lands.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_net"]
+
+
+def quantize_model(sym, arg_params, aux_params, **kwargs):
+    raise MXNetError(
+        "int8 quantization is not yet implemented in the TPU build; "
+        "bf16 (contrib.amp) is the supported reduced-precision path")
+
+
+def quantize_net(network, **kwargs):
+    raise MXNetError(
+        "int8 quantization is not yet implemented in the TPU build; "
+        "bf16 (contrib.amp) is the supported reduced-precision path")

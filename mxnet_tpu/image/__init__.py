@@ -1,0 +1,7 @@
+"""Image API (reference: python/mxnet/image/image.py ~L1-1500 — imdecode,
+imresize, augmenters, ImageIter; backed by src/operator/image/ ops)."""
+from .image import (imdecode, imencode, imread, imresize, resize_short,
+                    fixed_crop, center_crop, random_crop, color_normalize,
+                    CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
+                    RandomCropAug, CenterCropAug, HorizontalFlipAug,
+                    CastAug, ImageIter)

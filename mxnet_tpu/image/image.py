@@ -1,0 +1,317 @@
+"""Image decode/augment utilities (reference: python/mxnet/image/image.py).
+
+Host-side decode/augment uses OpenCV (the reference links OpenCV in C++);
+the resulting batches are device_put as NDArrays.  The throughput-critical
+RecordIO path lives in mxnet_tpu.io.ImageRecordIter.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["imdecode", "imencode", "imread", "imresize", "resize_short",
+           "fixed_crop", "center_crop", "random_crop", "color_normalize",
+           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ImageIter"]
+
+
+def _cv2():
+    import cv2
+
+    return cv2
+
+
+def _wrap(arr, to_ndarray=True):
+    if not to_ndarray:
+        return arr
+    from .. import ndarray as nd
+
+    return nd.array(arr, dtype=arr.dtype)
+
+
+def imdecode(buf, flag=1, to_rgb=True, to_ndarray=True):
+    """Decode an encoded image buffer to HWC uint8 (reference: mx.image.imdecode)."""
+    cv2 = _cv2()
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    img = cv2.imdecode(arr, cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed: invalid image data")
+    if flag and to_rgb:
+        img = img[:, :, ::-1]
+    if not flag:
+        img = img[:, :, None]
+    return _wrap(np.ascontiguousarray(img), to_ndarray)
+
+
+def imencode(img, fmt=".jpg", quality=95):
+    cv2 = _cv2()
+    from ..ndarray import NDArray
+
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    bgr = img[:, :, ::-1] if img.shape[-1] == 3 else img
+    params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] if fmt in (".jpg", ".jpeg") else []
+    ok, enc = cv2.imencode(fmt, bgr, params)
+    if not ok:
+        raise MXNetError("imencode failed")
+    return enc.tobytes()
+
+
+def imread(filename, flag=1, to_rgb=True, to_ndarray=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb, to_ndarray)
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    from ..ndarray import NDArray
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    out = cv2.resize(arr, (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _wrap(out, isinstance(src, NDArray))
+
+
+def resize_short(src, size, interp=2):
+    from ..ndarray import NDArray
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0: y0 + h, x0: x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size,
+                      interp), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        from ..ndarray import NDArray
+
+        if isinstance(src, NDArray):
+            return src.astype(self.typ)
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmentation list (reference ~L800)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator over .rec or .lst files (reference ~L1000)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None, **kwargs):
+        from ..io import DataDesc
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else []
+        self._records = None
+        self._items = []
+        if path_imgrec:
+            from .. import recordio
+
+            idx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self._records = recordio.MXIndexedRecordIO(idx, path_imgrec, "r")
+            self._items = list(self._records.keys)
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = np.array(parts[1:-1], dtype=np.float32)
+                        self._items.append((label, os.path.join(path_root,
+                                                                parts[-1])))
+            else:
+                for entry in imglist:
+                    self._items.append((np.asarray(entry[:-1], np.float32),
+                                        os.path.join(path_root, entry[-1])))
+        else:
+            raise MXNetError("need path_imgrec, path_imglist, or imglist")
+        self.provide_data = [DataDesc("data",
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size, label_width))]
+        self.reset()
+
+    def reset(self):
+        self._order = list(range(len(self._items)))
+        if self._shuffle:
+            pyrandom.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_one(self, i):
+        from .. import recordio
+
+        if self._records is not None:
+            raw = self._records.read_idx(self._items[i])
+            header, buf = recordio.unpack(raw)
+            img = imdecode(buf, to_ndarray=False)
+            label = np.atleast_1d(np.asarray(header.label, np.float32))
+        else:
+            label, path = self._items[i]
+            img = imread(path, to_ndarray=False)
+            label = np.atleast_1d(label)
+        for aug in self.auglist:
+            img = aug(img)
+            from ..ndarray import NDArray
+
+            if isinstance(img, NDArray):
+                img = img.asnumpy()
+        return img, label
+
+    def next(self):
+        from .. import ndarray as nd
+        from ..io import DataBatch
+
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        c, h, w = self.data_shape
+        batch = np.zeros((self.batch_size, c, h, w), np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        pad = 0
+        for slot in range(self.batch_size):
+            if self._cursor >= len(self._order):
+                pad += 1
+                continue
+            img, label = self._read_one(self._order[self._cursor])
+            self._cursor += 1
+            if img.shape[:2] != (h, w):
+                img = imresize(img, w, h)
+            batch[slot] = np.transpose(img.astype(np.float32), (2, 0, 1))
+            labels[slot, :len(label)] = label[: self.label_width]
+        return DataBatch(data=[nd.array(batch)], label=[nd.array(labels)],
+                         pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self

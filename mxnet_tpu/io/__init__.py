@@ -1,0 +1,9 @@
+"""Data iterators (reference: python/mxnet/io/io.py — DataIter, DataBatch,
+DataDesc, NDArrayIter ~L600, MXDataIter ~L800; backed by src/io/ iterators).
+
+The C++ RecordIO image pipeline (ImageRecordIter) plugs in via
+mxnet_tpu.io.image_iter once the native extension is built; NDArrayIter and
+CSVIter are pure Python/jax.
+"""
+from .io import DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter, CSVIter
+from .image_iter import ImageRecordIter

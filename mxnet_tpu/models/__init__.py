@@ -1,0 +1,6 @@
+"""Flagship model families built on gluon (transformer/BERT here;
+CNN zoo in gluon.model_zoo.vision)."""
+from . import transformer
+from . import bert
+from .bert import BERTModel, BERTForMLM, bert_base, bert_small
+from .transformer import TransformerEncoder, MultiHeadAttention

@@ -1,0 +1,150 @@
+"""Transformer building blocks (HybridBlocks).
+
+Reference parity: GluonNLP's transformer encoder (BASELINE configs 3/4 use
+BERT-base and Transformer-big built from these pieces) and the reference's
+fused attention matmuls (src/operator/contrib/transformer.cc
+interleaved_matmul_selfatt_* ~L1-300).
+
+TPU-native: attention is expressed as batched matmuls + softmax that XLA
+fuses and tiles onto the MXU; the qkv/out/ffn projection weights carry
+tensor-parallel shardings via mxnet_tpu.parallel.sharding rules (head axis
+split over the 'tp' mesh axis — collectives inserted by GSPMD).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "TransformerEncoder", "PositionalEmbedding"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross attention with fused qkv projection.
+
+    Weight layout (3*units, in) for qkv — the head dimension is the leading
+    axis so a 'tp' sharding of axis 0 splits heads across devices.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads != 0:
+            raise MXNetError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        self._dropout = dropout
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
+                                prefix="qkv_")
+            self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                 prefix="proj_")
+            self.attn_drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        # x: (B, T, C)
+        qkv = self.qkv(x)  # (B, T, 3C)
+        q, k, v = F.split(qkv, num_outputs=3, axis=-1)
+
+        def heads(t):
+            # (B, T, C) -> (B*H, T, hd)
+            t = t.reshape(0, 0, -4, self._num_heads, self._head_dim)
+            t = t.transpose((0, 2, 1, 3))
+            return t.reshape(-3, 0, 0)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
+        if mask is not None:
+            # mask: (B, T, T) with 1=keep; broadcast over heads
+            big_neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
+            m = mask.expand_dims(1)
+            m = F.broadcast_like(m, scores.reshape(
+                -4, -1, self._num_heads, 0, 0), lhs_axes=(1,), rhs_axes=(1,))
+            m = m.reshape(-3, 0, 0)
+            scores = F.where(m, scores, F.ones_like(scores) * big_neg)
+        attn = F.softmax(scores, axis=-1)
+        attn = self.attn_drop(attn)
+        out = F.batch_dot(attn, v)  # (B*H, T, hd)
+        out = out.reshape(-4, -1, self._num_heads, 0, 0)
+        out = out.transpose((0, 2, 1, 3)).reshape(0, 0, -3)
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.drop = nn.Dropout(dropout)
+        self._activation = activation
+
+    def hybrid_forward(self, F, x):
+        h = self.ffn_1(x)
+        h = (F.LeakyReLU(h, act_type="gelu") if self._activation == "gelu"
+             else F.Activation(h, act_type=self._activation))
+        return self.drop(self.ffn_2(h))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre/post-LN encoder layer."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False, activation="gelu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._pre_norm = pre_norm
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                           prefix="attn_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation,
+                                       prefix="ffn_")
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+            self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        if self._pre_norm:
+            x = x + self.drop(self.attn(self.ln1(x), mask))
+            return x + self.ffn(self.ln2(x))
+        x = self.ln1(x + self.drop(self.attn(x, mask)))
+        return self.ln2(x + self.ffn(x))
+
+
+class PositionalEmbedding(HybridBlock):
+    """Learned positional embedding (BERT-style)."""
+
+    def __init__(self, max_length, units, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._max_length = max_length
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(max_length, units))
+
+    def hybrid_forward(self, F, x, weight):
+        # x: (B, T, C); add positions [0, T)
+        T = x.shape[1]
+        return x + F.slice_axis(weight, axis=0, begin=0, end=T).expand_dims(0)
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False, activation="gelu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_layers = num_layers
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.layers.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout, pre_norm,
+                    activation, prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mask=None):
+        for cell in self.layers:
+            x = cell(x, mask)
+        return x

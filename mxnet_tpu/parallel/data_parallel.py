@@ -149,31 +149,50 @@ class DataParallelStep:
             for n, p in self._param_items
         }
 
-        # gather initial param values; shard per rules
+        if optimizer not in ("sgd", "adam"):
+            raise MXNetError(f"fused step supports sgd/adam, got {optimizer}")
+        self.params = None
+        self.opt_state = None
+        self._shardings = None
+        self._jitted = None
+        self._step_count = 0
+
+    def _ensure_state(self, example_inputs):
+        """Gather params (resolving deferred init via one eager forward) and
+        shard them per the rules."""
+        import jax
+
+        if self.params is not None:
+            return
+        from .. import autograd
+        from ..gluon.parameter import DeferredInitializationError
+
+        try:
+            for _, p in self._param_items:
+                p.data()
+        except DeferredInitializationError:
+            with autograd.pause(train_mode=True):
+                self.block(*example_inputs)
         names = [n for n, _ in self._param_items]
         shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
-        self._shardings = self.rules.shardings(mesh, shapes)
+        self._shardings = self.rules.shardings(self.mesh, shapes)
         self.params = {
             n: jax.device_put(p.data()._data, self._shardings[n])
             for n, p in self._param_items
         }
-        if optimizer == "sgd":
+        if self._optimizer == "sgd":
             self.opt_state = {
                 n: jax.device_put(
                     jax.numpy.zeros(shapes[n], jax.numpy.float32),
                     self._shardings[n])
                 for n in names
             }
-        elif optimizer == "adam":
+        else:
             z = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
                                    self._shardings[n]) for n in names}
             z2 = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
                                     self._shardings[n]) for n in names}
             self.opt_state = (z, z2, jax.numpy.zeros((), jax.numpy.int32))
-        else:
-            raise MXNetError(f"fused step supports sgd/adam, got {optimizer}")
-        self._jitted = None
-        self._step_count = 0
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -233,6 +252,8 @@ class DataParallelStep:
         from .. import random as _random
         from ..ndarray import NDArray
 
+        data_nd = data if isinstance(data, NDArray) else NDArray(data, ctx=self._ctx)
+        self._ensure_state((data_nd,))
         if self._jitted is None:
             self._build()
         data_arr = data._data if isinstance(data, NDArray) else data

@@ -1,0 +1,123 @@
+"""Profiler facade (reference: python/mxnet/profiler.py over src/profiler/ —
+set_config/start/stop/dump, aggregate stats; SURVEY §5.1).
+
+TPU-native: bridges to jax.profiler — start()/stop() capture a TensorBoard/
+perfetto trace of XLA execution (the analog of the reference's Chrome
+trace), and `scope`/`Task` map onto jax trace annotations.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from .base import MXNetError, env_bool
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "scope", "Task", "Frame", "Marker", "state"]
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "trace_dir": None}
+_running = False
+
+
+def set_config(**kwargs):
+    """Accepts the reference's kwargs (profile_all, profile_symbolic,
+    profile_imperative, profile_memory, profile_api, filename, ...)."""
+    _config.update(kwargs)
+    if "filename" in kwargs:
+        base = kwargs["filename"]
+        _config["trace_dir"] = os.path.splitext(base)[0] + "_jax_trace"
+
+
+def _trace_dir():
+    if _config["trace_dir"] is None:
+        _config["trace_dir"] = "mxnet_tpu_profile"
+    return _config["trace_dir"]
+
+
+def start():
+    global _running
+    import jax
+
+    if _running:
+        return
+    jax.profiler.start_trace(_trace_dir())
+    _running = True
+
+
+def stop():
+    global _running
+    import jax
+
+    if not _running:
+        return
+    jax.profiler.stop_trace()
+    _running = False
+
+
+def state():
+    return "running" if _running else "stopped"
+
+
+def pause():
+    stop()
+
+
+def resume():
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """The jax trace is written on stop_trace; this flushes and reports."""
+    if _running:
+        stop()
+
+
+def dumps(reset=False):
+    return f"profile trace directory: {_trace_dir()}"
+
+
+class scope:
+    """Named annotation scope (reference: profiler.scope)."""
+
+    def __init__(self, name="<unk>", append_mode=False):
+        self._name = name
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+
+        self._ctx = jax.profiler.TraceAnnotation(self._name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        return False
+
+
+class Task:
+    """Named task object (reference: profiler.Task)."""
+
+    def __init__(self, domain=None, name="task"):
+        self.name = name
+        self._ctx = None
+
+    def start(self):
+        import jax
+
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def stop(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+Frame = Task
+Marker = Task
+
+if env_bool("MXNET_PROFILER_AUTOSTART"):
+    start()

@@ -1,0 +1,66 @@
+"""Runtime feature detection (reference: python/mxnet/runtime.py Features()
+~L40 over src/libinfo.cc compile-time flags).
+
+Features reflect what this build actually provides: TPU/XLA capabilities
+replace the CUDA/MIOpen/MKLDNN flag set.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    feats = {
+        "TPU": False,
+        "XLA": True,
+        "PALLAS": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "MIOPEN": False,
+        "NCCL": False,
+        "ICI_COLLECTIVES": True,
+        "DIST_KVSTORE": True,
+        "OPENCV": False,
+        "BLAS_OPEN": True,
+        "F16C": True,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+    }
+    try:
+        import jax
+
+        feats["TPU"] = any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        pass
+    try:
+        import cv2  # noqa: F401
+
+        feats["OPENCV"] = True
+    except ImportError:
+        pass
+    return feats
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(
+            (name, Feature(name, enabled))
+            for name, enabled in _detect().items())
+
+    def __repr__(self):
+        return f"[{', '.join(f'{v.name}' + (' ✔' if v.enabled else ' ✖') for v in self.values())}]"
+
+    def is_enabled(self, feature_name: str) -> bool:
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

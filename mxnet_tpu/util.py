@@ -1,0 +1,50 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
+           "makedirs", "get_gpu_count", "get_gpu_memory"]
+
+
+def is_np_array() -> bool:
+    """Deprecated numpy-array semantics switch (2.x); always False in 1.x."""
+    return False
+
+
+def is_np_shape() -> bool:
+    return False
+
+
+def set_np(shape=True, array=True):
+    raise NotImplementedError(
+        "mx.np semantics are a 2.x feature; this framework tracks the 1.x API")
+
+
+def reset_np():
+    pass
+
+
+def use_np(func):
+    return func
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    from .context import gpu
+
+    stats = gpu(gpu_dev_id).memory_stats() or {}
+    free = stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+    return free, stats.get("bytes_limit", 0)

@@ -1,0 +1,91 @@
+"""Mesh/sharding/fused-train-step tests over the virtual 8-device CPU mesh
+(SURVEY §4.4 item 4: multi-device testing without hardware multiplicity)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.models import bert_small
+from mxnet_tpu.models.bert import bert_sharding_rules
+from mxnet_tpu.parallel import DataParallelStep, make_mesh, local_mesh
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(tp=2)
+    assert mesh.axis_names == ("dp", "pp", "sp", "tp", "ep")
+    assert mesh.devices.shape == (4, 1, 1, 2, 1)
+    mesh2 = local_mesh()
+    assert mesh2.devices.size == 8
+
+
+def test_fused_dp_step_converges():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    X = np.random.randn(64, 10).astype(np.float32)
+    W = np.random.randn(10, 3).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+    step = DataParallelStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            mesh=local_mesh(),
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.5,
+                                              "momentum": 0.9})
+    losses = []
+    for _ in range(40):
+        loss = step.step(nd.array(X), nd.array(Y))
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < 0.1 * losses[0], f"no convergence: {losses[:3]}...{losses[-3:]}"
+    # write back and check eager forward agrees
+    step.sync_to_block()
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array(Y), net(nd.array(X)))
+    assert acc.get()[1] > 0.95
+
+
+def test_bert_tp_dp_step():
+    """BERT-small training step sharded dp=4 x tp=2 over 8 devices."""
+    mesh = make_mesh(tp=2)
+    net = bert_small()
+    net.initialize(mx.init.Normal(0.02))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(logits, labels):
+        return loss_fn(logits.reshape(-1, logits.shape[-1]),
+                       labels.reshape(-1))
+
+    step = DataParallelStep(net, mlm_loss, mesh=mesh, optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-3},
+                            rules=bert_sharding_rules())
+    B, T, V = 8, 16, 512
+    tokens = np.random.randint(0, V, (B, T)).astype(np.int32)
+    labels = tokens.astype(np.float32)
+    l0 = None
+    for i in range(8):
+        loss = step.step(nd.array(tokens, dtype="int32"), nd.array(labels))
+        if i == 0:
+            l0 = float(np.asarray(loss))
+    l_last = float(np.asarray(loss))
+    assert np.isfinite(l_last)
+    assert l_last < l0, "loss should decrease while memorizing a fixed batch"
+    # verify the qkv weights actually carry a tp sharding
+    qkv_names = [n for n in step.params if n.endswith("qkv_weight")]
+    assert qkv_names
+    sh = step.params[qkv_names[0]].sharding
+    assert "tp" in str(sh.spec), f"expected tp sharding, got {sh.spec}"
+
+
+def test_kvstore_semantics():
+    kv = mx.kvstore.create("device")
+    kv.init(3, nd.ones((2, 2)))
+    # push/pull aggregation without updater: pull returns the pushed sum
+    kv.push(3, [nd.ones((2, 2)), nd.ones((2, 2)) * 2])
+    out = nd.zeros((2, 2))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 3 * np.ones((2, 2)))
+    with pytest.raises(mx.MXNetError):
+        mx.kvstore.create("dist_async")
