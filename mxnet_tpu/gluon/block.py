@@ -167,19 +167,61 @@ class Block:
                    force_reinit=False) -> None:
         self.collect_params().initialize(init, ctx, verbose, force_reinit)
 
+    def _collect_params_with_prefix(self, prefix: str = "") -> Dict[str, Parameter]:
+        """Structural dot-names ('0.weight', 'body.1.bias', ...) — the
+        scope-independent naming save_parameters uses (reference block.py
+        _collect_params_with_prefix ~L380)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
     def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
-        params = self.collect_params()
-        params.save(filename, strip_prefix=self.prefix)
+        """Save with structural names (reference gluon/block.py
+        save_parameters ~L400: format is independent of name scopes)."""
+        from .. import ndarray as nd
+
+        params = self._collect_params_with_prefix()
+        arg_dict = {}
+        seen = {}
+        for name, param in params.items():
+            if deduplicate and id(param) in seen:
+                continue
+            seen[id(param)] = name
+            arg_dict[name] = param._reduce()
+        nd.save(filename, arg_dict)
 
     def load_parameters(self, filename: str, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current") -> None:
-        params = self.collect_params()
-        params.load(filename, ctx, allow_missing, ignore_extra,
-                    restore_prefix=self.prefix)
+        from .. import ndarray as nd
+
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if loaded and params and not any(k in params for k in loaded):
+            # legacy full-name format (save_params): go through ParameterDict
+            self.collect_params().load(filename, ctx, allow_missing,
+                                       ignore_extra,
+                                       restore_prefix=self.prefix,
+                                       loaded=loaded)
+            return
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError(
+                        f"parameter {name} missing in {filename}")
+        for name, value in loaded.items():
+            if name not in params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(f"parameter {name} in file not in model")
+            params[name]._load_init(value, ctx, cast_dtype=cast_dtype)
 
     # legacy names
-    save_params = save_parameters
+    def save_params(self, filename: str) -> None:
+        self.collect_params().save(filename, strip_prefix=self.prefix)
 
     def load_params(self, filename, ctx=None, allow_missing=False,
                     ignore_extra=False):

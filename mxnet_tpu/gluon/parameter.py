@@ -271,6 +271,29 @@ class Parameter:
             nd._set_data(jax.device_put(src.astype(np.dtype(nd._data.dtype)),
                                         ctx.jax_device))
 
+    def _reduce(self):
+        """One host-complete copy of the value (reference Parameter._reduce:
+        device-0 copy for dense params)."""
+        self._check_initialized()
+        return next(iter(self._data.values()))
+
+    def _load_init(self, value, ctx=None, cast_dtype=False) -> None:
+        shape = getattr(value, "shape", None)
+        if _shape_known(self.shape) and tuple(self.shape) != tuple(shape):
+            raise MXNetError(
+                f"parameter {self.name} shape {self.shape} != loaded "
+                f"{tuple(shape)}")
+        if ctx is not None and self._data is None:
+            # loading initializes on the requested ctx, not current_context()
+            ctx_list = list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
+            init = self._deferred[0] if self._deferred else None
+            self._deferred = (init, ctx_list)
+        self.set_data(value)
+        if ctx is not None and self._data is not None:
+            ctx_list = list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
+            if list(self._data.keys()) != ctx_list:
+                self.reset_ctx(ctx_list)
+
     def zero_grad(self) -> None:
         if self._grad is None:
             return
@@ -438,10 +461,12 @@ class ParameterDict:
         nd.save(filename, arg_dict)
 
     def load(self, filename: str, ctx=None, allow_missing: bool = False,
-             ignore_extra: bool = False, restore_prefix: str = "") -> None:
+             ignore_extra: bool = False, restore_prefix: str = "",
+             loaded=None) -> None:
         from .. import ndarray as nd
 
-        loaded = nd.load(filename)
+        if loaded is None:
+            loaded = nd.load(filename)
         loaded = {restore_prefix + k: v for k, v in loaded.items()}
         if not allow_missing:
             for name in self.keys():

@@ -32,7 +32,7 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 prefix=None, params=None):
+                 causal=False, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if units % num_heads != 0:
             raise MXNetError(f"units {units} not divisible by heads {num_heads}")
@@ -40,6 +40,7 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._head_dim = units // num_heads
         self._dropout = dropout
+        self._causal = causal
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
                                 prefix="qkv_")
@@ -59,7 +60,24 @@ class MultiHeadAttention(HybridBlock):
             return t.reshape(-3, 0, 0)
 
         q, k, v = heads(q), heads(k), heads(v)
+        from .. import autograd as _ag
+
+        if mask is None and (self._dropout == 0.0 or not _ag.is_training()):
+            # fused flash-attention path (Pallas on TPU); only taken when
+            # attention-prob dropout is inactive, so it is numerically
+            # equivalent to the dense path
+            out = F._contrib_flash_attention(q, k, v, causal=self._causal)
+            out = out.reshape(-4, -1, self._num_heads, 0, 0)
+            out = out.transpose((0, 2, 1, 3)).reshape(0, 0, -3)
+            return self.proj(out)
         scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
+        if self._causal:
+            T = scores.shape[-1]
+            tril = F.array(np.tril(np.ones((T, T), np.float32)),
+                           ctx=scores.context)
+            neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
+            scores = F.broadcast_add(
+                scores, (1.0 - tril).expand_dims(0) * neg)
         if mask is not None:
             # mask: (B, T, T) with 1=keep; broadcast over heads
             big_neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4

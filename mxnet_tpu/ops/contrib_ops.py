@@ -1,0 +1,75 @@
+"""Contrib operators: fused attention (reference src/operator/contrib/
+transformer.cc interleaved_matmul_selfatt_qk/valatt ~L1-300, superseded
+here by a full flash-attention fusion).
+
+CV contrib ops (NMS / multibox / ROI) live in cv_ops.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dense_attention(q, k, v, causal, sm_scale):
+    s = jnp.einsum("nqd,nkd->nqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((qpos >= kpos)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("nqk,nkd->nqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@register("_contrib_flash_attention")
+def flash_attention_op(q, k, v, causal=False, sm_scale=None):
+    """Fused softmax(q k^T) v.  q/k/v: (N, L, D) or (B, H, L, D).
+
+    Pallas blockwise kernel on TPU; dense jnp composition elsewhere
+    (XLA still fuses the chain, it just materialises scores).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    from . import pallas as _pk
+
+    if _pk.enabled() and _pk.use_compiled():
+        return _pk.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if q.ndim == 4:
+        b, h = q.shape[:2]
+        out = _dense_attention(q.reshape(b * h, *q.shape[2:]),
+                               k.reshape(b * h, *k.shape[2:]),
+                               v.reshape(b * h, *v.shape[2:]),
+                               causal, sm_scale)
+        return out.reshape(b, h, *out.shape[1:])
+    return _dense_attention(q, k, v, causal, sm_scale)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(L, B, 3*H*D) interleaved qkv -> scaled q k^T scores (B*H, L, L).
+
+    Reference semantics: scores scaled by 1/sqrt(D) (transformer.cc ~L40).
+    """
+    L, B, P = queries_keys_values.shape
+    D = P // (3 * heads)
+    x = queries_keys_values.reshape(L, B, heads, 3, D)
+    q = x[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    k = x[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    return jnp.einsum("nqd,nkd->nqk", q, k) / math.sqrt(D)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """attention (B*H, L, L) @ v from interleaved qkv -> (L, B, H*D)."""
+    L, B, P = queries_keys_values.shape
+    D = P // (3 * heads)
+    x = queries_keys_values.reshape(L, B, heads, 3, D)
+    v = x[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    out = jnp.einsum("nqk,nkd->nqd", attention, v)
+    return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
+        L, B, heads * D)

@@ -353,6 +353,14 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    if (not output_mean_var and axis in (-1, data.ndim - 1)
+            and data.ndim >= 2):
+        from . import pallas as _pk
+
+        if _pk.enabled() and _pk.use_compiled():
+            out = _pk.layer_norm(data.reshape(-1, data.shape[-1]), gamma,
+                                 beta, eps=eps)
+            return out.reshape(data.shape)
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(x32 - mean), axis=axis, keepdims=True)

@@ -1,0 +1,42 @@
+"""Pallas TPU kernels for the hot fused ops.
+
+Reference parity: this package is the TPU-native replacement for the
+reference's hand-written device kernels and RTC fusion:
+  * src/operator/contrib/transformer.cc (interleaved_matmul_selfatt_qk /
+    valatt, ~L1-300) -> flash_attention (blockwise online-softmax attention,
+    a strictly stronger fusion than the reference's matmul-only fusion);
+  * src/operator/nn/softmax{-inl.h,.cc,.cu} fused softmax+CE grad ->
+    softmax_cross_entropy;
+  * src/operator/nn/layer_norm* -> layer_norm;
+  * src/operator/fusion/fused_op.cc (NVRTC pointwise fusion, env
+    MXNET_USE_FUSION ~L100) -> the `enabled()` gate below: XLA already
+    fuses pointwise chains, so only the blockwise kernels live here.
+
+All kernels run in interpret mode on CPU (so the test suite exercises them
+on the 8-device virtual mesh) and compile through Mosaic on TPU.
+"""
+from .flash_attention import flash_attention
+from .fused import layer_norm, softmax_cross_entropy
+
+import os
+
+
+def enabled() -> bool:
+    """MXNET_USE_FUSION gate (default on), reference env-var semantics."""
+    return os.environ.get("MXNET_USE_FUSION", "1") not in ("0", "false")
+
+
+def use_compiled() -> bool:
+    """True when Pallas kernels should lower through Mosaic (TPU backend).
+
+    Single source of truth for call-site gates: kernels run interpreted
+    exactly when this is False, so a gate that checks `enabled() and
+    use_compiled()` can never disagree with the kernels' interpret flag.
+    """
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+__all__ = ["flash_attention", "softmax_cross_entropy", "layer_norm",
+           "enabled"]

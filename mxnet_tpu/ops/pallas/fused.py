@@ -1,0 +1,160 @@
+"""Fused row-wise Pallas kernels: softmax cross-entropy and layer norm.
+
+Reference parity:
+  * softmax_cross_entropy: src/operator/nn/softmax{-inl.h,.cc,.cu} fused
+    log-softmax + gather (the reference fuses softmax with its grad; here
+    the whole loss row reduces in one VMEM pass);
+  * layer_norm: src/operator/nn/layer_norm* (Welford pass + affine in one
+    kernel).
+
+Backward passes are closed-form jnp expressions under jax.custom_vjp —
+XLA fuses those chains on its own; the win of Pallas is the forward
+single-pass reduction without materialising intermediates.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    from . import use_compiled
+
+    return not use_compiled()
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# softmax cross-entropy
+# ---------------------------------------------------------------------------
+def _sce_kernel(ignore_label, x_ref, y_ref, loss_ref):
+    x = x_ref[...].astype(jnp.float32)            # (bn, C)
+    y = y_ref[...]                                # (bn, 1) int32
+    m = x.max(axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    lse = m[:, 0] + jnp.log(e.sum(axis=-1))
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.where(cols == y, x, 0.0).sum(axis=-1)
+    loss = lse - picked
+    if ignore_label is not None:
+        loss = jnp.where(y[:, 0] == ignore_label, 0.0, loss)
+    loss_ref[...] = loss[:, None]
+
+
+def _sce_fwd_impl(logits, labels, ignore_label):
+    n, c = logits.shape
+    bn = min(256, _round_up(n, 8))
+    n_p = _round_up(n, bn)
+    x = jnp.pad(logits, ((0, n_p - n), (0, 0)))
+    y = jnp.pad(labels.astype(jnp.int32), ((0, n_p - n),))[:, None]
+    loss = pl.pallas_call(
+        functools.partial(_sce_kernel, ignore_label),
+        grid=(n_p // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_p, 1), jnp.float32),
+        interpret=_interpret(),
+    )(x, y)
+    return loss[:n, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_cross_entropy(logits, labels, ignore_label=None):
+    """Per-row -log softmax(logits)[label]; logits (N, C), labels (N,) int.
+
+    Rows whose label equals `ignore_label` contribute zero loss/grad.
+    """
+    return _sce_fwd_impl(logits, labels, ignore_label)
+
+
+def _sce_fwd(logits, labels, ignore_label):
+    return _sce_fwd_impl(logits, labels, ignore_label), (logits, labels)
+
+
+def _sce_bwd(ignore_label, res, g):
+    logits, labels = res
+    x = logits.astype(jnp.float32)
+    p = jax.nn.softmax(x, axis=-1)
+    onehot = jax.nn.one_hot(labels, x.shape[-1], dtype=jnp.float32)
+    d = (p - onehot) * g[:, None]
+    if ignore_label is not None:
+        d = jnp.where((labels == ignore_label)[:, None], 0.0, d)
+    return d.astype(logits.dtype), None
+
+
+softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# layer norm
+# ---------------------------------------------------------------------------
+def _ln_kernel(eps, x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref):
+    x = x_ref[...].astype(jnp.float32)            # (bn, C)
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o = xc * rstd * g_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    o_ref[...] = o.astype(o_ref.dtype)
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
+
+
+def _ln_fwd_impl(x, gamma, beta, eps):
+    n, c = x.shape
+    bn = min(256, _round_up(n, 8))
+    n_p = _round_up(n, bn)
+    xp = jnp.pad(x, ((0, n_p - n), (0, 0)))
+    out, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_kernel, eps),
+        grid=(n_p // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                   pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_p, c), x.dtype),
+                   jax.ShapeDtypeStruct((n_p, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n_p, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(xp, gamma[None, :], beta[None, :])
+    return out[:n], mu[:n], rstd[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, gamma, beta, eps=1e-5):
+    """Row-wise layer norm over the last axis; x (N, C), gamma/beta (C,)."""
+    out, _, _ = _ln_fwd_impl(x, gamma, beta, eps)
+    return out
+
+
+def _ln_fwd(x, gamma, beta, eps):
+    out, mu, rstd = _ln_fwd_impl(x, gamma, beta, eps)
+    return out, (x, gamma, mu, rstd)
+
+
+def _ln_bwd(eps, res, g):
+    x, gamma, mu, rstd = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    xhat = (xf - mu) * rstd
+    dgamma = (gf * xhat).sum(axis=0)
+    dbeta = gf.sum(axis=0)
+    dxhat = gf * gamma.astype(jnp.float32)[None, :]
+    c = x.shape[-1]
+    dx = rstd / c * (c * dxhat - dxhat.sum(-1, keepdims=True)
+                     - xhat * (dxhat * xhat).sum(-1, keepdims=True))
+    return dx.astype(x.dtype), dgamma.astype(gamma.dtype), dbeta.astype(
+        gamma.dtype)
+
+
+layer_norm.defvjp(_ln_fwd, _ln_bwd)

@@ -9,4 +9,5 @@ ICI/DCN (SURVEY §2.4, §5.8).
 from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
                    global_allreduce)
 from .data_parallel import DataParallelStep, make_train_step
+from .ring import ring_attention, ring_self_attention
 from . import sharding

@@ -276,3 +276,31 @@ def test_metrics():
     comp.add(mx.metric.MAE())
     names, values = comp.get()
     assert len(names) == 2
+
+
+def test_save_load_parameters_structural_roundtrip():
+    """save_parameters uses scope-independent structural names, so loading
+    into a freshly-built (even uninitialized) net works — reference
+    gluon/block.py _collect_params_with_prefix semantics."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+        return net
+
+    net = build()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(4, 5).astype("float32"))
+    y1 = net(x).asnumpy()
+    f = os.path.join(tempfile.mkdtemp(), "p.params")
+    net.save_parameters(f)
+    net2 = build()
+    net2.load_parameters(f)
+    np.testing.assert_allclose(y1, net2(x).asnumpy(), rtol=1e-6, atol=1e-6)

@@ -1,0 +1,154 @@
+"""Pallas fused kernels + ring attention vs plain-jax references.
+
+Mirrors the reference's check_consistency oracle (tests/python/gpu/
+test_operator_gpu.py ~check_consistency): same math, two backends.
+Kernels run in interpret mode on the CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import pallas as pk
+from mxnet_tpu.parallel import ring_self_attention
+from mxnet_tpu.parallel.mesh import device_mesh
+
+
+def _ref_attention(q, k, v, causal=False, sm_scale=None):
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("nqd,nkd->nqk", q, k) * sm_scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        # kernel semantics: query i attends keys 0..i (positions from 0)
+        mask = np.tril(np.ones((lq, lk), bool))
+        s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("nqk,nkd->nqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("lq,lk,d", [(64, 64, 32), (40, 72, 16)])
+def test_flash_attention_forward(causal, lq, lk, d):
+    if causal and lq != lk:
+        pytest.skip("causal needs square")
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(3, lq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(3, lk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(3, lk, d), jnp.float32)
+    out = pk.flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad(causal):
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, 32, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 16), jnp.float32)
+
+    def f_flash(q, k, v):
+        return pk.flash_attention(q, k, v, causal=causal, block_q=16,
+                                  block_k=16).sum()
+
+    def f_ref(q, k, v):
+        return _ref_attention(q, k, v, causal=causal).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_flash_attention_4d_and_jit():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 4, 24, 8), jnp.float32)
+    out = jax.jit(lambda q: pk.flash_attention(q, q, q))(q)
+    ref = _ref_attention(q.reshape(8, 24, 8), q.reshape(8, 24, 8),
+                         q.reshape(8, 24, 8)).reshape(2, 4, 24, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(37, 11), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 11, 37), jnp.int32)
+    loss = pk.softmax_cross_entropy(x, y)
+    ref = -jax.nn.log_softmax(x)[jnp.arange(37), y]
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # gradient
+    g = jax.grad(lambda x: pk.softmax_cross_entropy(x, y).sum())(x)
+    gref = jax.grad(lambda x: (-jax.nn.log_softmax(x)[jnp.arange(37), y]
+                               ).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_softmax_cross_entropy_ignore_label():
+    x = jnp.asarray(np.random.RandomState(4).randn(8, 5), jnp.float32)
+    y = jnp.asarray([0, 1, -1, 2, -1, 3, 4, 0], jnp.int32)
+    loss = pk.softmax_cross_entropy(x, y, ignore_label=-1)
+    assert float(loss[2]) == 0.0 and float(loss[4]) == 0.0
+    g = jax.grad(lambda x: pk.softmax_cross_entropy(x, y, -1).sum())(x)
+    assert np.abs(np.asarray(g)[2]).sum() == 0.0
+
+
+def test_layer_norm():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(19, 33), jnp.float32)
+    gm = jnp.asarray(rng.randn(33), jnp.float32)
+    bt = jnp.asarray(rng.randn(33), jnp.float32)
+
+    def ref(x, gm, bt):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * gm + bt
+
+    out = pk.layer_norm(x, gm, bt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, gm, bt)),
+                               rtol=1e-5, atol=1e-5)
+    g1 = jax.grad(lambda *a: pk.layer_norm(*a).sum(), argnums=(0, 1, 2))(
+        x, gm, bt)
+    g2 = jax.grad(lambda *a: ref(*a).sum(), argnums=(0, 1, 2))(x, gm, bt)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = device_mesh(("sp",), (8,))
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(4, 64, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(4, 64, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(4, 64, 16), jnp.float32)
+    out = ring_self_attention(mesh, q, k, v, causal=causal)
+    ref = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_grad():
+    mesh = device_mesh(("sp",), (8,))
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 32, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 8), jnp.float32)
+
+    def f_ring(q, k, v):
+        return ring_self_attention(mesh, q, k, v, causal=True).sum()
+
+    def f_ref(q, k, v):
+        return _ref_attention(q, k, v, causal=True).sum()
+
+    g1 = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
