@@ -1,24 +1,45 @@
-"""Model zoo: vision (reference: gluon/model_zoo/vision/__init__.py).
-
-get_model resolves by name; families land incrementally (resnet first —
-the BASELINE flagship; alexnet/vgg/mobilenet/squeezenet/densenet follow).
-"""
+"""Model zoo: vision (reference: gluon/model_zoo/vision/__init__.py —
+get_model name table ~L1-150)."""
 from ....base import MXNetError
 from .resnet import *
 from .resnet import __all__ as _resnet_all
+from .alexnet import *
+from .vgg import *
+from .squeezenet import *
+from .densenet import *
+from .mobilenet import *
+from .inception import *
 
 _models = {name: globals()[name] for name in _resnet_all
            if name.startswith("resnet")}
+_models.update({
+    "alexnet": alexnet,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "inceptionv3": inception_v3,
+})
 
 
 def get_model(name, **kwargs):
     name = name.lower()
-    try:
-        return _models[name](**kwargs)
-    except KeyError:
+    if name not in _models:
         raise MXNetError(
             f"Model {name} is not supported yet. Available: "
-            f"{sorted(_models)}") from None
+            f"{sorted(_models)}")
+    if kwargs.pop("pretrained", False):
+        raise MXNetError(
+            "pretrained weights are unavailable in a zero-egress "
+            "environment; initialize() and train, or load_parameters() "
+            "from a local file")
+    return _models[name](**kwargs)
 
 
 def register_model(name, fn):

@@ -1,0 +1,63 @@
+"""Model zoo smoke tests (reference model: tests/python/unittest/
+test_gluon_model_zoo.py — constructs each family and runs a tiny forward)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+# (name, input_size) — small inputs where the architecture allows it
+SMALL = [
+    ("resnet18_v1", 32),
+    ("resnet18_v2", 32),
+    ("mobilenet0.25", 32),
+    ("mobilenetv2_0.25", 32),
+    ("squeezenet1.1", 64),
+    ("densenet121", 32),
+]
+
+
+@pytest.mark.parametrize("name,size", SMALL)
+def test_model_forward(name, size):
+    mx.random.seed(0)
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(1, 3, size, size))
+    y = net(x)
+    assert y.shape == (1, 10)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_alexnet_vgg_forward():
+    # fixed-size dense heads need >= 224 spatial input
+    mx.random.seed(0)
+    for name in ("alexnet", "vgg11"):
+        net = get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        y = net(nd.zeros((1, 3, 224, 224)))
+        assert y.shape == (1, 10)
+
+
+def test_inception_forward():
+    mx.random.seed(0)
+    net = get_model("inceptionv3", classes=10)
+    net.initialize(mx.init.Xavier())
+    y = net(nd.zeros((1, 3, 299, 299)))
+    assert y.shape == (1, 10)
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError):
+        get_model("resnet9999")
+
+
+def test_model_zoo_hybridize():
+    mx.random.seed(0)
+    net = get_model("mobilenet0.25", classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 3, 32, 32))
+    y1 = net(x)
+    y2 = net(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)
