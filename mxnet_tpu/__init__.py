@@ -31,6 +31,11 @@ from . import metric
 from . import kvstore
 from .kvstore import KVStore
 from . import recordio
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import module
+from . import module as mod
 from . import gluon
 from . import parallel
 from . import io

@@ -421,6 +421,11 @@ class HybridBlock(Block):
         super().cast(dtype)
 
     def __call__(self, *args):
+        from .. import symbol as _sym
+
+        if args and isinstance(args[0], _sym.Symbol):
+            # symbol trace: bypass hooks/cached-op, compose the graph
+            return self.forward(*args)
         # inside an active trace, always run the eager path (ops see tracers)
         if self._active and not trace_active():
             try:
@@ -443,6 +448,13 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         """Dispatch to hybrid_forward with params bound (reference ~L750)."""
+        from .. import symbol as _sym
+
+        if isinstance(x, _sym.Symbol):
+            # symbol trace (export path): params become named variables
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym, x, *args, **params)
         ctx = x.context
         try:
             params = {name: p.data(ctx) for name, p in self._reg_params.items()}
@@ -457,27 +469,148 @@ class HybridBlock(Block):
         raise NotImplementedError
 
     def export(self, path: str, epoch: int = 0):
-        """Serialize params (+ a json stub) for deployment.
+        """Emit {path}-symbol.json + {path}-{epoch:04d}.params (reference:
+        gluon/block.py export ~L900): trace hybrid_forward with Symbol
+        proxies, then save parameters keyed arg:/aux: by graph role, so
+        SymbolBlock.imports / Module.load round-trip."""
+        from .. import symbol as _sym
+        from ..ndarray import save as nd_save
 
-        The reference emits {path}-symbol.json + params; the traced-jaxpr
-        equivalent of the symbol graph lands with the Symbol facade.
-        """
-        import json
+        data = _sym.var("data")
+        out = self(data)
+        if isinstance(out, (list, tuple)):
+            out = _sym.Group(out)
+        out.save(f"{path}-symbol.json")
 
-        params = self.collect_params()
-        params.save(f"{path}-{epoch:04d}.params")
-        meta = {"format": "mxnet_tpu-hybrid", "class": type(self).__name__,
-                "params": sorted(params.keys())}
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(meta, f, indent=2)
+        aux_names = set(out.list_auxiliary_states())
+        save_dict = {}
+        for param in self.collect_params().values():
+            if param._data is None:
+                raise MXNetError(
+                    f"export: parameter {param.name!r} is not initialized "
+                    "(run one forward to resolve deferred shapes first)")
+            arr = param._reduce()
+            key = (f"aux:{param.name}" if param.name in aux_names
+                   else f"arg:{param.name}")
+            save_dict[key] = arr
+        nd_save(f"{path}-{epoch:04d}.params", save_dict)
+        return out
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from a symbol graph (reference: SymbolBlock).
+    """Construct a block from a symbol graph (reference: gluon/block.py
+    SymbolBlock.imports ~L900).
 
-    Lands with the Symbol facade module; kept as a named placeholder so
-    imports of the public surface don't break."""
+    The symbol's whole graph runs as one pure jax function through the
+    imperative dispatch layer, so autograd recording, tracing inside an
+    outer HybridBlock, and jit all work unchanged."""
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "SymbolBlock requires the Symbol facade (see mxnet_tpu.symbol)")
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from .. import symbol as _sym
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = _sym.Group(outputs)
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sym = outputs
+        self._sym_input_names = [s.name for s in inputs]
+        arg_names = outputs.list_arguments()
+        self._sym_aux_names = list(outputs.list_auxiliary_states())
+        self._sym_param_names = [n for n in arg_names
+                                 if n not in self._sym_input_names]
+        for n in self._sym_param_names:
+            p = self.params.get(n, grad_req="write", allow_deferred_init=True)
+            self._reg_params[n] = p
+        for n in self._sym_aux_names:
+            p = self.params.get(n, grad_req="null", allow_deferred_init=True)
+            self._reg_params[n] = p
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as _sym
+        from ..context import current_context
+
+        sym = _sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_sym.var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            from ..model import load_params as _lp
+            import re
+
+            m = re.search(r"-(\d+)\.params$", param_file)
+            prefix = param_file[: m.start()] if m else None
+            if prefix is not None:
+                arg, aux = _lp(prefix, int(m.group(1)))
+            else:
+                from .. import ndarray as nd
+
+                raw = nd.load(param_file)
+                arg, aux = {}, {}
+                for k, v in raw.items():
+                    tp, _, name = k.partition(":")
+                    (aux if tp == "aux" else arg)[name if tp in ("arg", "aux")
+                                                  else k] = v
+            ctx = ctx or current_context()
+            for name, val in {**arg, **aux}.items():
+                if name in ret._reg_params:
+                    ret._reg_params[name]._load_init(val, ctx=ctx)
+        return ret
+
+    def _infer_sym_param_shapes(self, *args):
+        shapes = {n: a.shape
+                  for n, a in zip(self._sym_input_names, args)}
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**shapes)
+        arg_names = self._sym.list_arguments()
+        for name, shp in zip(arg_names, arg_shapes):
+            if name in self._reg_params:
+                self._reg_params[name]._set_shape_if_deferred(shp)
+                self._reg_params[name]._finish_deferred_init()
+        for name, shp in zip(self._sym_aux_names, aux_shapes):
+            self._reg_params[name]._set_shape_if_deferred(shp)
+            self._reg_params[name]._finish_deferred_init()
+
+    def forward(self, x, *args):
+        from .. import autograd
+        from .. import random as _rng
+        from ..ops import registry as _reg
+        from ..symbol.symbol import build_graph_eval
+
+        ctx = x.context
+        try:
+            params = {n: p.data(ctx) for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_sym_param_shapes(x, *args)
+            params = {n: p.data(ctx) for n, p in self._reg_params.items()}
+
+        training = autograd.is_training()
+        eval_fn = build_graph_eval(self._sym._entries, training)
+        key = _rng.next_key()
+        data_nds = [x, *args]
+        names = (self._sym_input_names
+                 + [n for n in params])
+        input_nds = data_nds + [params[n] for n in params]
+        aux_upd = list(self._sym_aux_names) if training else []
+        n_out = len(self._sym.list_outputs())
+
+        def fn(*arrays):
+            vals = dict(zip(names, arrays))
+            outs, aux_updates = eval_fn(vals, key)
+            flat = tuple(outs) + tuple(aux_updates.get(n, vals[n])
+                                       for n in aux_upd)
+            # single output unwraps: the tape passes a bare cotangent for
+            # one-output nodes, so the vjp structure must match
+            return flat[0] if len(flat) == 1 else flat
+
+        results = _reg.invoke_fn(fn, input_nds)
+        if not isinstance(results, (list, tuple)):
+            results = [results]
+        outs, aux_vals = results[:n_out], results[n_out:]
+        for n, v in zip(aux_upd, aux_vals):
+            self._reg_params[n].set_data(v.detach())
+        return outs[0] if n_out == 1 else list(outs)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError

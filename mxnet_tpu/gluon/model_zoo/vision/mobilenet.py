@@ -94,7 +94,11 @@ class MobileNetV2(HybridBlock):
                               [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
                               + [160] * 3 + [320]]
             ts = [1] + [6] * 16
-            strides = [1, 2] + [1, 1, 2, 1, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1]
+            # t,c,n,s = (1,16,1,1),(6,24,2,2),(6,32,3,2),(6,64,4,2),
+            # (6,96,3,1),(6,160,3,2),(6,320,1,1): first block of each group
+            # carries the stride (reference: gluon/model_zoo/vision/mobilenet.py)
+            strides = ([1] + [2, 1] + [2, 1, 1] + [2, 1, 1, 1]
+                       + [1, 1, 1] + [2, 1, 1] + [1])
             for in_c, c, t, s in zip(in_channels_group, channels_group, ts,
                                      strides):
                 self.features.add(LinearBottleneck(in_channels=in_c,

@@ -330,8 +330,17 @@ class Parameter:
                 g._set_data(g._data.astype(dtype_np(dtype)))
 
     def var(self):
-        """Symbol-API compat: parameters are just named slots here."""
-        return self
+        """A symbol variable carrying this parameter's name (used when a
+        HybridBlock is traced into a Symbol graph for export).  Cached so
+        repeated calls (weight sharing within one trace) return the SAME
+        graph node — otherwise list_arguments would show duplicates."""
+        from .. import symbol as _sym
+
+        cached = getattr(self, "_var_sym", None)
+        if cached is None:
+            cached = _sym.var(self.name, shape=self.shape, dtype=self.dtype)
+            self._var_sym = cached
+        return cached
 
 
 class Constant(Parameter):

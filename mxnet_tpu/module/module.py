@@ -1,0 +1,234 @@
+"""Module: the symbolic-era trainer over one bound Executor.
+
+Reference parity: python/mxnet/module/module.py (Module.bind ~L400,
+forward/backward, update via kvstore push/pull ~L600) and
+executor_group.py (DataParallelExecutorGroup ~L1-700).
+
+TPU-native design: the reference shards each batch across a `context` list
+of GPUs with one executor per device plus kvstore reduce.  Under XLA the
+same data parallelism is a sharding annotation on ONE executable (see
+mxnet_tpu.parallel), so Module binds a single whole-graph executor on
+ctx[0]; multi-chip training goes through `DataParallelStep`/`Trainer`, not
+through per-device executor groups.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from ..io.io import DataDesc
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        from ..context import current_context
+
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        ctx = context or current_context()
+        self._context = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._exec = None
+        self._updater = None
+        self._optimizer = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        data_shapes = [_as_desc(d) for d in data_shapes]
+        label_shapes = [_as_desc(l) for l in (label_shapes or [])]
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        shapes = {d.name: d.shape for d in data_shapes + label_shapes}
+        req: Dict[str, str] = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names or name in self._label_names:
+                req[name] = "null"
+            elif name in self._fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req
+        self._exec = self._symbol.simple_bind(ctx=self._context,
+                                              grad_req=req, **shapes)
+        if shared_module is not None and shared_module._exec is not None:
+            # share parameter arrays with another module (reference:
+            # BucketingModule's shared executor groups): same NDArray objects
+            for name, arr in shared_module._exec.arg_dict.items():
+                if name in self._exec.arg_dict and name in self._param_names:
+                    self._exec.arg_dict[name] = arr
+            for name, arr in shared_module._exec.aux_dict.items():
+                if name in self._exec.aux_dict:
+                    self._exec.aux_dict[name] = arr
+            for name, arr in shared_module._exec.grad_dict.items():
+                if name in self._exec.grad_dict:
+                    self._exec.grad_dict[name] = arr
+        self.binded = True
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        preloaded = getattr(self, "_preloaded", None)
+        if preloaded is not None and arg_params is None:
+            arg_params, aux_params = preloaded
+        from .. import initializer as _init
+
+        default_init = initializer or _init.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._set_data(arg_params[name].copyto(self._context)._data)
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError(
+                    f"param {name!r} missing from arg_params "
+                    f"(pass allow_missing=True to initialize it)")
+            else:
+                default_init(name, arr)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params and name in aux_params:
+                arr._set_data(aux_params[name].copyto(self._context)._data)
+            else:
+                default_init(name, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copyto(self._context)
+               for n in self._param_names}
+        aux = {n: a.copyto(self._context)
+               for n, a in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        from .. import optimizer as _opt
+
+        if isinstance(optimizer, str):
+            optimizer = _opt.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if not self.binded:
+            raise MXNetError("call bind before forward")
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data or []):
+            feeds[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("call init_optimizer before update")
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpointing -----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        mod._preloaded_states = (f"{prefix}-{epoch:04d}.states"
+                                 if load_optimizer_states else None)
+        return mod
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._symbol.list_outputs(), self._exec.outputs)]
+
+
+def _as_desc(d):
+    if isinstance(d, DataDesc):
+        return d
+    name, shape = d[0], d[1]
+    return DataDesc(name=name, shape=tuple(shape))

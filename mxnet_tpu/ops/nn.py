@@ -444,3 +444,84 @@ def bilinear_resize_2d(data, height=1, width=1, scale_height=None, scale_width=N
     if scale_height is not None:
         height, width = int(h * scale_height), int(w * scale_width)
     return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# regression output heads (reference: src/operator/regression_output-inl.h)
+# Legacy semantics like SoftmaxOutput: backward IGNORES the incoming
+# cotangent and emits the analytic per-element residual * grad_scale.
+# ---------------------------------------------------------------------------
+def _make_regression_output(transform, residual, grad_scale):
+    @jax.custom_vjp
+    def fwd(data, label):
+        return transform(data)
+
+    def f(data, label):
+        out = transform(data)
+        return out, (out, label)
+
+    def b(res, g):
+        out, label = res
+        return (residual(out, label) * grad_scale, jnp.zeros_like(label))
+
+    fwd.defvjp(f, b)
+    return fwd
+
+
+_regression_cache = {}
+
+
+def _regression_output(kind, data, label, grad_scale):
+    key = (kind, grad_scale)
+    fn = _regression_cache.get(key)
+    if fn is None:
+        transform = {"linear": lambda x: x,
+                     "mae": lambda x: x,
+                     "logistic": jax.nn.sigmoid}[kind]
+        residual = {"linear": lambda o, l: o - l,
+                    "mae": lambda o, l: jnp.sign(o - l),
+                    "logistic": lambda o, l: o - l}[kind]
+        fn = _make_regression_output(transform, residual, grad_scale)
+        _regression_cache[key] = fn
+    return fn(data, label.reshape(data.shape))
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _regression_output("linear", data, label, grad_scale)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _regression_output("mae", data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _regression_output("logistic", data, label, grad_scale)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Forward identity; backward seeds grad_scale as the gradient,
+    normalized by batch size or by the count of elements above valid_thresh
+    (reference: src/operator/make_loss-inl.h)."""
+    @jax.custom_vjp
+    def fwd(x):
+        return x
+
+    def f(x):
+        return x, x
+
+    def b(x, g):
+        if normalization == "batch":
+            denom = jnp.asarray(x.shape[0], x.dtype)
+        elif normalization == "valid":
+            denom = jnp.maximum(
+                jnp.sum(x > valid_thresh).astype(x.dtype), 1.0)
+        else:
+            denom = jnp.asarray(1.0, x.dtype)
+        return (jnp.full_like(g, grad_scale) / denom,)
+
+    fwd.defvjp(f, b)
+    return fwd(data)

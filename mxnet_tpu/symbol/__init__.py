@@ -1,0 +1,106 @@
+"""The ``mx.sym`` namespace.
+
+Reference parity: python/mxnet/symbol/ — like ``mx.nd``, the op namespace is
+generated from the registry at import time (symbol/register.py ~L100), so
+every registered operator is available in both the imperative and the
+symbolic spelling (SURVEY.md invariant #2).
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     _apply_sym, _auto_name, _Node, _op_arg_names, _AUX_ARGS)
+from .executor import Executor
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor"]
+
+
+def _make_sym_stub(op):
+    req_names, varargs = _op_arg_names(op.name)
+    sig = inspect.signature(op.fn)
+    kw_order = [p.name for p in sig.parameters.values()
+                if p.default is not p.empty]
+    kw_ok = set(kw_order)
+    no_bias_default = False
+    if "no_bias" in sig.parameters:
+        no_bias_default = bool(sig.parameters["no_bias"].default)
+
+    def stub(*args, **kwargs):
+        name = kwargs.pop("name", None) or _auto_name(op.name)
+        kwargs.pop("attr", None)
+        sym_inputs = []
+        # positional symbols fill required slots, then varargs
+        pos = [a for a in args if isinstance(a, Symbol)]
+        attrs_pos = [a for a in args if not isinstance(a, Symbol)]
+        # keyword symbols by arg name
+        by_name = {}
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                by_name[k] = kwargs.pop(k)
+        if varargs and not req_names:
+            # fully-variadic op (Concat, add_n, UpSampling): all positional
+            # symbols are inputs
+            sym_inputs = pos
+            pos = []
+        else:
+            for i, aname in enumerate(req_names):
+                if aname in by_name:
+                    sym_inputs.append(by_name.pop(aname))
+                elif pos:
+                    sym_inputs.append(pos.pop(0))
+                else:
+                    # auto-create variable (reference: symbolic auto args);
+                    # aux-state args keep their canonical suffix
+                    sym_inputs.append(Variable(f"{name}_{aname}"))
+            if varargs and not kwargs.get("no_bias", no_bias_default):
+                if by_name.get(varargs) is not None:
+                    sym_inputs.append(by_name.pop(varargs))
+                elif pos:
+                    sym_inputs.extend(pos)
+                    pos = []
+                elif varargs == "bias":
+                    sym_inputs.append(Variable(f"{name}_bias"))
+        if pos:
+            raise MXNetError(
+                f"{op.name}: {len(pos)} unused positional symbol input(s)")
+        if by_name:
+            raise MXNetError(f"{op.name}: unknown symbol kwargs "
+                             f"{sorted(by_name)}")
+        # leftover positional scalars map onto keyword attrs in order
+        if attrs_pos:
+            free = [k for k in kw_order if k not in kwargs]
+            for a, k in zip(attrs_pos, free):
+                kwargs[k] = a
+        bad = set(kwargs) - kw_ok
+        if bad:
+            raise MXNetError(f"{op.name}: unknown attrs {sorted(bad)}")
+        entries = [s._entries[0] for s in sym_inputs]
+        node = _Node(op.name, name, kwargs, entries)
+        return Symbol([(node, 0)])
+
+    stub.__name__ = op.name
+    stub.__doc__ = op.__doc__
+    return stub
+
+
+_SKIP_PREFIXES = ("_random_", "_sample_", "sample_")
+
+
+def _populate():
+    g = globals()
+    for opname in _reg.list_ops():
+        if opname.startswith(_SKIP_PREFIXES):
+            continue
+        op = _reg.get_op(opname)
+        g[opname] = _make_sym_stub(op)
+        __all__.append(opname)
+    g["concat"] = g["Concat"]
+    g["flatten"] = g["Flatten"]
+    g["cast"] = g["Cast"]
+
+
+_populate()

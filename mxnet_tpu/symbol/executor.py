@@ -1,0 +1,251 @@
+"""Executor: bound symbolic graph.
+
+Reference parity: python/mxnet/symbol/executor.py (Executor.forward/backward
+~L100-300) over src/executor/graph_executor.cc (GraphExecutor::Init ~L300,
+RunOps ~L1300) and the memory-planning passes.
+
+TPU-native design: `bind` captures the argument arrays; `forward` runs ONE
+jit-compiled function for the whole graph (XLA owns memory planning, fusion,
+and scheduling — the reference's InitDataEntryMemory/PlanMemory/bulk-exec
+work).  `backward` runs a second jitted function computing the vjp of the
+whole graph w.r.t. the gradient-requiring arguments; like the reference's
+backward pass it writes/accumulates into pre-allocated grad arrays
+(grad_req write/add/null).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .symbol import Symbol, build_graph_eval
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        from ..context import current_context
+        from ..ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict: Dict[str, NDArray] = _as_dict(args, arg_names, "args")
+        self.aux_dict: Dict[str, NDArray] = _as_dict(aux_states, aux_names,
+                                                     "aux_states")
+        self.grad_req: Dict[str, str] = _req_dict(grad_req, arg_names)
+        self.grad_dict: Dict[str, NDArray] = _as_dict(args_grad, arg_names,
+                                                      "args_grad", partial=True)
+        self.outputs: List[NDArray] = []
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._bwd_fn = None
+        self._last_train_feed = None
+
+    # -- construction helpers ---------------------------------------------
+    @classmethod
+    def _simple_bind(cls, symbol: Symbol, ctx, grad_req, type_dict, shapes):
+        from ..context import current_context
+        from ..ndarray import zeros
+
+        ctx = ctx or current_context()
+        type_dict = type_dict or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+
+        args = {}
+        for name, shp in zip(arg_names, arg_shapes):
+            dtype = type_dict.get(name, "float32")
+            args[name] = zeros(shp, ctx=ctx, dtype=dtype)
+        aux = {}
+        for name, shp in zip(aux_names, aux_shapes):
+            aux[name] = zeros(shp, ctx=ctx, dtype=type_dict.get(name, "float32"))
+
+        req = _req_dict(grad_req, arg_names)
+        grads = {}
+        for name in arg_names:
+            if req.get(name, "null") != "null":
+                grads[name] = zeros(args[name].shape, ctx=ctx,
+                                    dtype=type_dict.get(name, "float32"))
+        return cls(symbol, ctx=ctx, args=args, args_grad=grads,
+                   grad_req=req, aux_states=aux)
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs):
+        from ..ndarray import NDArray, array
+
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"unknown argument {name!r}")
+            if isinstance(val, NDArray):
+                self.arg_dict[name]._set_data(val.copyto(self._ctx)._data)
+            else:
+                self.arg_dict[name]._set_data(
+                    array(val, ctx=self._ctx)._data)
+
+        feed = {name: a._data for name, a in self.arg_dict.items()}
+        feed.update({name: a._data for name, a in self.aux_dict.items()})
+        key = self._next_key()
+
+        fwd = self._fwd_cache.get(is_train)
+        if fwd is None:
+            import jax
+
+            fwd = jax.jit(build_graph_eval(self._symbol._entries, is_train))
+            self._fwd_cache[is_train] = fwd
+
+        outs, aux_updates = fwd(feed, key)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        for name, val in aux_updates.items():
+            self.aux_dict[name]._set_data(val)
+        if is_train:
+            self._last_train_feed = (feed, key)
+        return self.outputs
+
+    # -- backward ----------------------------------------------------------
+    def backward(self, out_grads=None):
+        from ..ndarray import NDArray
+
+        if self._last_train_feed is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        feed, key = self._last_train_feed
+
+        diff_names = sorted(
+            name for name, req in self.grad_req.items()
+            if req != "null" and name in self.arg_dict
+            and np.dtype(self.arg_dict[name]._data.dtype).kind == "f")
+
+        if self._bwd_fn is None:
+            import jax
+
+            entries = self._symbol._entries
+            eval_fn = build_graph_eval(entries, True)
+            names = tuple(diff_names)
+
+            def bwd(diff_vals, const_vals, key, ograds):
+                def f(dv):
+                    full = dict(const_vals)
+                    full.update(dict(zip(names, dv)))
+                    outs, _ = eval_fn(full, key)
+                    return outs
+
+                _, vjp = jax.vjp(f, tuple(feedv for feedv in diff_vals))
+                (grads,) = vjp(ograds)
+                return grads
+
+            self._bwd_fn = jax.jit(bwd)
+
+        if out_grads is None:
+            import jax.numpy as jnp
+
+            ograds = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data for g in out_grads]
+
+        diff_vals = tuple(feed[n] for n in diff_names)
+        const_vals = {k: v for k, v in feed.items() if k not in set(diff_names)}
+        grads = self._bwd_fn(diff_vals, const_vals, key, list(ograds))
+
+        for name, g in zip(diff_names, grads):
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if self.grad_req[name] == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, val in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    val.copyto(self._ctx)._data.astype(
+                        self.arg_dict[name]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown arg param {name!r}")
+        for name, val in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set_data(val.copyto(self._ctx)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux param {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **shapes):
+        """Rebind with new input shapes, carrying over current parameter and
+        aux values whose shapes are unchanged (reference: Executor.reshape).
+        jit recompiles per signature, so only the arrays are reallocated."""
+        new = Executor._simple_bind(self._symbol, self._ctx,
+                                    self.grad_req, None, shapes)
+        for name, arr in self.arg_dict.items():
+            tgt = new.arg_dict.get(name)
+            if tgt is not None and tgt.shape == arr.shape:
+                tgt._set_data(arr._data)
+        for name, arr in self.aux_dict.items():
+            tgt = new.aux_dict.get(name)
+            if tgt is not None and tgt.shape == arr.shape:
+                tgt._set_data(arr._data)
+        return new
+
+    def _next_key(self):
+        from .. import random as _rng
+
+        return _rng.next_key()
+
+
+def _as_dict(values, names, what, partial=False):
+    from ..ndarray import NDArray
+
+    if values is None:
+        return {}
+    if isinstance(values, dict):
+        for k in values:
+            if k not in names:
+                raise MXNetError(f"{what}: unknown name {k!r}")
+        return dict(values)
+    values = list(values)
+    if not partial and len(values) != len(names):
+        raise MXNetError(f"{what}: expected {len(names)} arrays "
+                         f"({names}), got {len(values)}")
+    out = {}
+    for name, v in zip(names, values):
+        if v is not None:
+            if not isinstance(v, NDArray):
+                raise MXNetError(f"{what}: {name} is not an NDArray")
+            out[name] = v
+    return out
+
+
+def _req_dict(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    if isinstance(grad_req, dict):
+        return {n: grad_req.get(n, "null") for n in arg_names}
+    raise MXNetError("grad_req must be str, list, or dict")

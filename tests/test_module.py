@@ -1,0 +1,149 @@
+"""Module system tests (reference behavioral spec:
+tests/python/unittest/test_module.py; convergence pattern from
+tests/python/train/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd, io
+
+
+def _toy_problem(n=256, seed=0):
+    """Linearly separable 2-class problem."""
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+def _mlp_sym():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_fit_converges():
+    x, y = _toy_problem()
+    train = io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    # NB: SoftmaxOutput grads are per-row (summed over batch through the
+    # weights), reference semantics — so lr is scaled for batch_size=32
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Xavier())
+    train.reset()
+    score = mod.score(train, "acc")
+    assert dict(score)["accuracy"] > 0.9
+
+
+def test_module_forward_predict_shapes():
+    x, y = _toy_problem(64)
+    it = io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (64, 2)
+    probs = out.asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(64), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_problem(64)
+    it = io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "toy")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    mod2.init_params()
+    it.reset()
+    batch = next(it)
+    mod.forward(batch, is_train=False)
+    it.reset()
+    batch = next(it)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_get_set_params():
+    x, y = _toy_problem(32)
+    it = io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    # perturb then restore
+    orig = arg["fc1_weight"].asnumpy().copy()
+    mod._exec.arg_dict["fc1_weight"]._set_data(
+        nd.zeros(orig.shape)._data)
+    mod.set_params(arg, aux)
+    np.testing.assert_allclose(
+        mod._exec.arg_dict["fc1_weight"].asnumpy(), orig)
+
+
+def test_bucketing_module():
+    """Shape-bucketed modules share parameters (reference:
+    test_module.py test_bucket_module semantics)."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc_shared")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    for key, n in ((8, 8), (8, 8), (8, 8)):
+        batch = io.DataBatch(
+            data=[nd.array(np.random.rand(4, n).astype(np.float32))],
+            label=[nd.array(np.zeros(4, np.float32))],
+            bucket_key=key,
+            provide_data=[("data", (4, n))],
+            provide_label=[("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # switching to the same-key bucket reuses the module
+    assert len(mod._buckets) == 1
+
+    # a second bucket shares the fc weights
+    batch = io.DataBatch(
+        data=[nd.array(np.random.rand(4, 8).astype(np.float32))],
+        label=[nd.array(np.zeros(4, np.float32))],
+        bucket_key=8)
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 4)
+
+
+def test_module_input_grads():
+    data = sym.Variable("data")
+    out = sym.LinearRegressionOutput(sym.FullyConnected(
+        data, num_hidden=1, name="fc"), name="lro")
+    mod = mx.mod.Module(out, label_names=("lro_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))],
+             label_shapes=[("lro_label", (2, 1))])
+    mod.init_params(initializer=mx.init.One())
+    mod.init_optimizer()
+    batch = io.DataBatch(data=[nd.ones((2, 3))],
+                         label=[nd.zeros((2, 1))])
+    mod.forward_backward(batch)
+    g = mod._exec.grad_dict["fc_weight"].asnumpy()
+    assert g.shape == (1, 3)
+    assert np.abs(g).sum() > 0

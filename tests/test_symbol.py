@@ -1,0 +1,158 @@
+"""Symbol / Executor tests (reference behavioral spec:
+tests/python/unittest/test_symbol.py and test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments_order():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape_auto_params():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 10))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 10)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert d["softmax_label"] == (8,)
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_batchnorm():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    net = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert net.list_auxiliary_states() == ["bn1_moving_mean",
+                                           "bn1_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes == [(2, 8, 4, 4)]
+
+
+def test_executor_forward_matches_nd():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(4, 5))
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    w = np.random.RandomState(1).rand(3, 5).astype(np.float32)
+    b = np.random.RandomState(2).rand(3).astype(np.float32)
+    exe.arg_dict["fc_weight"]._set_data(nd.array(w)._data)
+    exe.arg_dict["fc_bias"]._set_data(nd.array(b)._data)
+    (out,) = exe.forward(is_train=False, data=x)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+
+
+def test_executor_backward_grads():
+    # loss = sum((x*w)^2) -> dw = 2*w*x^2 summed over batch
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.sum(sym.square(data * w))
+    exe = out.simple_bind(ctx=mx.cpu(), grad_req="write", data=(3,), w=(3,))
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.array([0.5, -1.0, 2.0], np.float32)
+    exe.forward(is_train=True, data=xv, w=wv)
+    exe.backward()
+    gw = exe.grad_dict["w"].asnumpy()
+    np.testing.assert_allclose(gw, 2 * wv * xv * xv, rtol=1e-5)
+
+
+def test_softmax_output_backward():
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(data, name="softmax")
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req={"data": "write"},
+                          data=(2, 3))
+    x = np.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]], np.float32)
+    label = np.array([2, 0], np.float32)
+    exe.forward(is_train=True, data=x, softmax_label=label)
+    exe.backward()
+    p = exe.outputs[0].asnumpy()
+    onehot = np.zeros((2, 3), np.float32)
+    onehot[np.arange(2), label.astype(int)] = 1
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               (p - onehot), rtol=1e-4, atol=1e-6)
+
+
+def test_json_round_trip(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net-symbol.json")
+    net.save(fname)
+    loaded = sym.load(fname)
+    assert loaded.list_arguments() == net.list_arguments()
+    assert loaded.list_outputs() == net.list_outputs()
+    # same numerics after reload
+    shapes = {"data": (2, 6)}
+    a1, o1, _ = net.infer_shape(**shapes)
+    a2, o2, _ = loaded.infer_shape(**shapes)
+    assert a1 == a2 and o1 == o2
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    s1 = a + b
+    s2 = a * b
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    exe = g.bind(ctx=mx.cpu(), args={"a": nd.array([2.0]),
+                                     "b": nd.array([3.0])}, grad_req="null")
+    outs = exe.forward()
+    assert outs[0].asnumpy()[0] == 5.0
+    assert outs[1].asnumpy()[0] == 6.0
+    first = g[0]
+    assert first.list_outputs() == g.list_outputs()[:1]
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    data2 = sym.Variable("data2")
+    net2 = sym.Activation(data2, act_type="relu", name="act")
+    composed = net2(data2=net1)
+    args = composed.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "data2" not in args
+
+
+def test_scalar_arith_and_internals():
+    a = sym.Variable("a")
+    s = (a + 1.0) * 2.0
+    exe = s.bind(ctx=mx.cpu(), args={"a": nd.array([3.0])}, grad_req="null")
+    assert exe.forward()[0].asnumpy()[0] == 8.0
+    internals = _mlp().get_internals()
+    assert "fc1_output" in internals.list_outputs()
+
+
+def test_grad_req_add():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.sum(data * w)
+    exe = out.simple_bind(ctx=mx.cpu(), grad_req={"w": "add", "data": "null"},
+                          data=(2,), w=(2,))
+    xv = np.array([1.0, 2.0], np.float32)
+    wv = np.array([1.0, 1.0], np.float32)
+    exe.forward(is_train=True, data=xv, w=wv)
+    exe.backward()
+    exe.forward(is_train=True, data=xv, w=wv)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), 2 * xv)
