@@ -42,6 +42,7 @@ from . import io
 from . import image
 from . import callback
 from . import model
+from . import operator
 from . import profiler
 from . import runtime
 from . import util

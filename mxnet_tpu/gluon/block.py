@@ -537,22 +537,14 @@ class SymbolBlock(HybridBlock):
         inputs = [_sym.var(n) for n in input_names]
         ret = SymbolBlock(sym, inputs)
         if param_file is not None:
-            from ..model import load_params as _lp
-            import re
+            from .. import ndarray as nd
 
-            m = re.search(r"-(\d+)\.params$", param_file)
-            prefix = param_file[: m.start()] if m else None
-            if prefix is not None:
-                arg, aux = _lp(prefix, int(m.group(1)))
-            else:
-                from .. import ndarray as nd
-
-                raw = nd.load(param_file)
-                arg, aux = {}, {}
-                for k, v in raw.items():
-                    tp, _, name = k.partition(":")
-                    (aux if tp == "aux" else arg)[name if tp in ("arg", "aux")
-                                                  else k] = v
+            raw = nd.load(param_file)
+            arg, aux = {}, {}
+            for k, v in raw.items():
+                tp, _, name = k.partition(":")
+                (aux if tp == "aux" else arg)[name if tp in ("arg", "aux")
+                                              else k] = v
             ctx = ctx or current_context()
             for name, val in {**arg, **aux}.items():
                 if name in ret._reg_params:
@@ -575,8 +567,15 @@ class SymbolBlock(HybridBlock):
     def forward(self, x, *args):
         from .. import autograd
         from .. import random as _rng
+        from .. import symbol as _sym
         from ..ops import registry as _reg
         from ..symbol.symbol import build_graph_eval
+
+        if isinstance(x, _sym.Symbol):
+            # symbol trace (re-export path): splice the stored graph onto
+            # the incoming symbols by input-variable name
+            mapping = dict(zip(self._sym_input_names, [x, *args]))
+            return self._sym(**mapping)
 
         ctx = x.context
         try:

@@ -95,10 +95,13 @@ class BucketingModule(BaseModule):
         key = data_batch.bucket_key
         if key is None:
             key = self._curr_bucket_key
-        data_shapes = data_batch.provide_data or [
-            ("data", d.shape) for d in (data_batch.data or [])]
+        default_mod = self._buckets[self._default_bucket_key]
+        data_shapes = data_batch.provide_data or list(
+            zip(default_mod.data_names,
+                [d.shape for d in (data_batch.data or [])]))
         label_shapes = data_batch.provide_label or (
-            [("softmax_label", l.shape) for l in data_batch.label]
+            list(zip(default_mod.label_names,
+                     [l.shape for l in data_batch.label]))
             if data_batch.label else None)
         self.switch_bucket(key, data_shapes, label_shapes)
         self._curr_module.forward(data_batch, is_train=is_train)

@@ -59,9 +59,13 @@ class Module(BaseModule):
         self._label_shapes = label_shapes
 
         shapes = {d.name: d.shape for d in data_shapes + label_shapes}
+        self._inputs_need_grad = inputs_need_grad
         req: Dict[str, str] = {}
         for name in self._symbol.list_arguments():
-            if name in self._data_names or name in self._label_names:
+            if name in self._data_names:
+                req[name] = grad_req if (inputs_need_grad
+                                         and for_training) else "null"
+            elif name in self._label_names:
                 req[name] = "null"
             elif name in self._fixed_param_names or not for_training:
                 req[name] = "null"
@@ -109,6 +113,10 @@ class Module(BaseModule):
         for name, arr in self._exec.aux_dict.items():
             if aux_params and name in aux_params:
                 arr._set_data(aux_params[name].copyto(self._context)._data)
+            elif aux_params is not None and not allow_missing:
+                raise MXNetError(
+                    f"aux state {name!r} missing from aux_params "
+                    f"(pass allow_missing=True to initialize it)")
             else:
                 default_init(name, arr)
         self.params_initialized = True
@@ -138,6 +146,10 @@ class Module(BaseModule):
             optimizer = _opt.create(optimizer, **dict(optimizer_params))
         self._optimizer = optimizer
         self._updater = _opt.get_updater(optimizer)
+        states_file = getattr(self, "_preloaded_states", None)
+        if states_file is not None:
+            self.load_optimizer_states(states_file)
+            self._preloaded_states = None
         self.optimizer_initialized = True
 
     # -- execution ---------------------------------------------------------

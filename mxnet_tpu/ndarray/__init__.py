@@ -19,6 +19,8 @@ from .. import engine as _engine
 from .ndarray import NDArray, array, from_jax
 from . import random  # noqa: F401  (nd.random namespace)
 from .utils import save, load
+from . import contrib  # noqa: F401  (nd.contrib namespace)
+from ..operator import Custom  # noqa: F401  (mx.nd.Custom)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "eye", "linspace", "save", "load", "waitall", "concat", "stack",

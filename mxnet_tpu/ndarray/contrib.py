@@ -1,0 +1,261 @@
+"""The ``mx.nd.contrib`` namespace.
+
+Reference parity: python/mxnet/ndarray/contrib.py — short spellings of the
+``_contrib_*`` registered ops plus the control-flow operators
+(foreach/while_loop/cond, reference: src/operator/control_flow.cc ~L1-1500).
+
+TPU-native design: control flow lowers to lax.scan / lax.while_loop /
+lax.cond through the shared dispatch layer, so the loop body compiles into
+the SAME XLA program as the surrounding graph — the reference executes
+sub-CachedOps per iteration instead; scan is strictly better on TPU
+(no per-iteration dispatch, full fusion across the loop boundary).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _eager_recording(arrays) -> bool:
+    """True when autograd is recording outside any jit trace — the case
+    where control flow must unroll in Python for tape visibility."""
+    import jax
+
+    from .. import autograd
+
+    if not autograd.is_recording():
+        return False
+    return not any(isinstance(a._data, jax.core.Tracer) for a in arrays)
+
+
+def foreach(body, data, init_states):
+    """Iterate body over axis 0 of data, threading states (reference:
+    control_flow.cc Foreach).  body(data_i, states) -> (outputs, states).
+
+    While autograd is recording eagerly, the loop runs in Python so the tape
+    sees every op (gradients flow to closure-captured parameters, like the
+    reference's imperative path); otherwise it lowers to one lax.scan.
+    """
+    data_list = _as_list(data)
+    data_multi = isinstance(data, (list, tuple))
+    states = _as_list(init_states)
+    states_multi = isinstance(init_states, (list, tuple))
+    ctx = data_list[0].context
+    n_data, n_state = len(data_list), len(states)
+    out_multi = [None]  # filled at trace time
+
+    if _eager_recording(data_list + states):
+        from . import stack as nd_stack
+
+        cur = init_states
+        collected = None
+        for i in range(data_list[0].shape[0]):
+            xs = [d[i] for d in data_list]
+            outs, cur = body(xs if data_multi else xs[0], cur)
+            outs_l = _as_list(outs)
+            if collected is None:
+                collected = [[] for _ in outs_l]
+                out_multi[0] = isinstance(outs, (list, tuple))
+            for lst, o in zip(collected, outs_l):
+                lst.append(o)
+        stacked = [nd_stack(*lst, axis=0) for lst in collected]
+        return (stacked if out_multi[0] else stacked[0]), cur
+
+    def fn(*arrays):
+        import jax
+
+        xs = arrays[:n_data]
+        carry0 = arrays[n_data:]
+
+        def step(carry, x):
+            d_nds = [NDArray(v, ctx=ctx) for v in x]
+            s_nds = [NDArray(c, ctx=ctx) for c in carry]
+            outs, new_s = body(d_nds if data_multi else d_nds[0],
+                               s_nds if states_multi else s_nds[0])
+            outs_l = _as_list(outs)
+            out_multi[0] = isinstance(outs, (list, tuple))
+            new_l = _as_list(new_s)
+            if len(new_l) != n_state:
+                raise MXNetError("foreach body must return the same number "
+                                 "of states as init_states")
+            return (tuple(s._data for s in new_l),
+                    tuple(o._data for o in outs_l))
+
+        final, stacked = jax.lax.scan(step, tuple(carry0), tuple(xs))
+        return tuple(stacked) + tuple(final)
+
+    results = _reg.invoke_fn(fn, data_list + states)
+    results = _as_list(results)
+    n_out = len(results) - n_state
+    outputs = results[:n_out]
+    out_states = results[n_out:]
+    outputs = outputs if out_multi[0] else outputs[0]
+    return outputs, (out_states if states_multi else out_states[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop (reference: control_flow.cc WhileLoop).
+
+    func(*loop_vars) -> (step_output(s), new_loop_vars); returns
+    (outputs stacked over max_iterations with zero padding, final vars).
+    Static upper bound keeps XLA shapes fixed (the reference pads too).
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    loop_list = _as_list(loop_vars)
+    vars_multi = isinstance(loop_vars, (list, tuple))
+    ctx = loop_list[0].context
+    n_vars = len(loop_list)
+    out_multi = [None]
+
+    if _eager_recording(loop_list):
+        from . import stack as nd_stack
+        from . import zeros as nd_zeros
+
+        cur = list(loop_list)
+        collected = None
+        steps = 0
+        for _ in range(int(max_iterations)):
+            p = cond(*cur)
+            if not bool(p.asnumpy().reshape(())):
+                break
+            outs, new_vars = func(*cur)
+            outs_l = _as_list(outs)
+            if collected is None:
+                collected = [[] for _ in outs_l]
+                out_multi[0] = isinstance(outs, (list, tuple))
+            for lst, o in zip(collected, outs_l):
+                lst.append(o)
+            cur = _as_list(new_vars)
+            steps += 1
+        if collected is None:
+            raise MXNetError("while_loop body never ran; cannot infer "
+                             "output shapes")
+        stacked = []
+        for lst in collected:
+            pad = [nd_zeros(lst[0].shape, ctx=ctx, dtype=lst[0].dtype)
+                   for _ in range(int(max_iterations) - steps)]
+            stacked.append(nd_stack(*(lst + pad), axis=0))
+        outputs = stacked if out_multi[0] else stacked[0]
+        return outputs, (cur if vars_multi else cur[0])
+
+    def fn(*arrays):
+        import jax
+        import jax.numpy as jnp
+
+        def step(carry, _):
+            done, count, vs = carry
+            v_nds = [NDArray(v, ctx=ctx) for v in vs]
+            pred = cond(*v_nds)
+            pred_v = (pred._data if isinstance(pred, NDArray)
+                      else jnp.asarray(pred)).reshape(()).astype(bool)
+            active = (~done) & pred_v
+            outs, new_vs = func(*v_nds)
+            outs_l = _as_list(outs)
+            out_multi[0] = isinstance(outs, (list, tuple))
+            new_l = [v._data for v in _as_list(new_vs)]
+            kept = tuple(jnp.where(active, nv, ov)
+                         for nv, ov in zip(new_l, vs))
+            step_out = tuple(
+                jnp.where(active, o._data, jnp.zeros_like(o._data))
+                for o in outs_l)
+            return ((done | ~pred_v, count + active.astype(jnp.int32), kept),
+                    step_out)
+
+        carry0 = (jnp.asarray(False), jnp.asarray(0, jnp.int32),
+                  tuple(arrays))
+        import jax
+
+        (done, count, final), stacked = jax.lax.scan(
+            step, carry0, None, length=int(max_iterations))
+        return tuple(stacked) + tuple(final)
+
+    import jax.numpy as jnp  # noqa: F401  (used inside fn)
+
+    results = _as_list(_reg.invoke_fn(fn, loop_list))
+    n_out = len(results) - n_vars
+    outputs = results[:n_out]
+    final_vars = results[n_out:]
+    outputs = outputs if out_multi[0] else outputs[0]
+    return outputs, (final_vars if vars_multi else final_vars[0])
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Conditional (reference: control_flow.cc Cond) -> lax.cond.
+    pred: scalar NDArray or callable(*inputs); branches take `inputs`
+    (or are nullary); both must return the same structure."""
+    in_list = _as_list(inputs) if inputs is not None else []
+    pred_is_nd = isinstance(pred, NDArray)
+    op_inputs = in_list + ([pred] if pred_is_nd else [])
+    if not op_inputs:
+        raise MXNetError("cond needs `inputs` and/or an NDArray pred")
+    ctx = op_inputs[0].context
+
+    if _eager_recording(op_inputs):
+        p = pred if pred_is_nd else pred(*in_list)
+        branch = (then_func if bool(p.asnumpy().reshape(()))
+                  else else_func)
+        return branch(*in_list) if in_list else branch()
+
+    def fn(*arrays):
+        import jax
+        import jax.numpy as jnp
+
+        nds = [NDArray(a, ctx=ctx) for a in arrays[:len(in_list)]]
+        if pred_is_nd:
+            p_v = arrays[len(in_list)]
+        else:
+            p = pred(*nds)
+            p_v = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+        p_v = jnp.reshape(p_v, ()).astype(bool)
+
+        def run(branch):
+            out = branch(*nds) if in_list else branch()
+            out_multi[0] = isinstance(out, (list, tuple))
+            outs = _as_list(out)
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in outs)
+
+        return jax.lax.cond(p_v, lambda _: run(then_func),
+                            lambda _: run(else_func), operand=None)
+
+    out_multi = [None]
+    results = _as_list(_reg.invoke_fn(fn, op_inputs))
+    return results if out_multi[0] else results[0]
+
+
+def _populate():
+    g = globals()
+    for name in _reg.list_ops():
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            op = _reg.get_op(name)
+
+            def make(op):
+                def stub(*args, **kwargs):
+                    out = kwargs.pop("out", None)
+                    kwargs.pop("name", None)
+                    from .ndarray import array
+
+                    inputs = [a if isinstance(a, NDArray) else array(a)
+                              for a in args]
+                    return _reg.invoke(op, inputs, out=out, **kwargs)
+
+                stub.__name__ = op.name
+                stub.__doc__ = op.__doc__
+                return stub
+
+            g[short] = make(op)
+            __all__.append(short)
+
+
+_populate()

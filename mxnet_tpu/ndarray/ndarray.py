@@ -386,9 +386,11 @@ class NDArray:
     def reshape_like(self, other):
         return _reg.invoke_fn(lambda x, y: x.reshape(y.shape), [self, other])
 
-    def transpose(self, *axes):
-        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
-            axes = tuple(axes[0])
+    def transpose(self, *axes, **kwargs):
+        if "axes" in kwargs:
+            axes = kwargs["axes"]  # reference spelling: x.transpose(axes=(...))
+        elif len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = axes[0]
         return _reg.invoke_by_name("transpose", [self], axes=tuple(axes))
 
     def flatten(self):

@@ -1,0 +1,151 @@
+"""mx.operator: user-defined operators in Python.
+
+Reference parity: python/mxnet/operator.py (CustomOp/CustomOpProp/register)
+over src/operator/custom/custom.cc (~L100: CustomOperator runs Python
+callbacks on a dedicated thread pool outside engine workers).
+
+TPU-native design: a custom op runs eagerly on concrete arrays (like the
+reference, which exits the engine for the Python callback) and integrates
+with autograd through the same tape mechanism as autograd.Function — the
+user's backward() is recorded as the node's vjp.  Inside a hybridize/jit
+trace custom ops are not traceable (they are opaque Python); CachedOp
+graphs containing one fall back to eager, matching the reference's
+behavioral contract that Custom breaks bulk execution.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_OPS: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad_req (reference semantics)."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst._set_data(dst._data + src._data.astype(dst._data.dtype))
+        else:  # write / inplace
+            dst._set_data(src._data.astype(dst._data.dtype))
+
+
+class CustomOpProp:
+    """Op metadata provider (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass under op_type name."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators() -> List[str]:
+    return sorted(_CUSTOM_OPS)
+
+
+def _invoke_custom(op_type: str, inputs, **kwargs):
+    """mx.nd.Custom implementation (reference: MXImperativeInvokeEx on the
+    'Custom' op -> custom.cc CustomOperator)."""
+    from . import autograd
+    from .ndarray import NDArray, zeros
+
+    prop_cls = _CUSTOM_OPS.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(f"unknown custom op type {op_type!r}")
+    import inspect
+
+    accepted = inspect.signature(prop_cls.__init__).parameters
+    init_kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+    prop = prop_cls(**init_kwargs)
+
+    arg_names = prop.list_arguments()
+    if len(inputs) != len(arg_names):
+        raise MXNetError(f"custom op {op_type}: expected {len(arg_names)} "
+                         f"inputs {arg_names}, got {len(inputs)}")
+    ctx = inputs[0].context
+    in_shapes = [list(x.shape) for x in inputs]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(ctx, in_shapes,
+                              [x.dtype for x in inputs])
+
+    n_out = len(out_shapes)
+    aux = [zeros(tuple(s), ctx=ctx) for s in aux_shapes]
+
+    class _Fn(autograd.Function):
+        def forward(self, *in_data):
+            out_data = [zeros(tuple(s), ctx=ctx) for s in out_shapes]
+            op.forward(is_train=autograd.is_training(),
+                       req=["write"] * n_out,
+                       in_data=list(in_data), out_data=out_data, aux=aux)
+            self._saved = (list(in_data), out_data)
+            return out_data[0] if n_out == 1 else tuple(out_data)
+
+        def backward(self, *out_grad):
+            in_data, out_data = self._saved
+            in_grad = [zeros(x.shape, ctx=ctx, dtype=x.dtype)
+                       for x in in_data]
+            op.backward(req=["write"] * len(in_data),
+                        out_grad=list(out_grad), in_data=in_data,
+                        out_data=out_data, in_grad=in_grad, aux=aux)
+            return in_grad[0] if len(in_grad) == 1 else tuple(in_grad)
+
+    return _Fn()(*inputs)
+
+
+def Custom(*args, op_type=None, **kwargs):
+    """mx.nd.Custom(*inputs, op_type='name', **op_kwargs)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    from .ndarray import NDArray, array
+
+    inputs = [a if isinstance(a, NDArray) else array(a) for a in args]
+    return _invoke_custom(op_type, inputs, **kwargs)

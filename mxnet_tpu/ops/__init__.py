@@ -17,3 +17,4 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import cv_ops  # noqa: F401

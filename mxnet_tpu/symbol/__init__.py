@@ -104,3 +104,5 @@ def _populate():
 
 
 _populate()
+
+from . import contrib  # noqa: E402,F401  (after stub autogen)

@@ -258,8 +258,6 @@ class Symbol:
         structs = self._infer_structs(shapes={}, dtypes=kwargs, partial=True)
         args = self.list_arguments()
         auxs = self.list_auxiliary_states()
-        name2node = {n.name: n for n in _topo_order(self._entries)
-                     if n.is_variable()}
         def dt(name):
             s = structs["vars"].get(name)
             return None if s is None else np.dtype(s.dtype)

@@ -1,0 +1,112 @@
+"""Control-flow op + CustomOp tests (reference spec:
+tests/python/unittest/test_contrib_control_flow.py, test_operator.py
+CustomOp tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(5, dtype=np.float32))
+    init = nd.zeros((1,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               [0, 1, 3, 6, 10])
+    np.testing.assert_allclose(final.asnumpy(), [10])
+
+
+def test_foreach_multi_state_and_grad():
+    data = nd.array(np.ones((4, 2), np.float32))
+    w = nd.array(np.array([2.0, 3.0], np.float32))
+    w.attach_grad()
+
+    def body(x, states):
+        (s,) = states
+        return x * w, [s + (x * w).sum()]
+
+    with autograd.record():
+        outs, states = nd.contrib.foreach(body, data, [nd.zeros((1,))])
+        loss = states[0].sum()
+    loss.backward()
+    # d loss / dw = 4 iterations x 1.0 each
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0, 4.0])
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 2, (i + 1, s + i)
+
+    outs, (i, s) = nd.contrib.while_loop(
+        cond, func, (nd.array([0.0]), nd.array([0.0])), max_iterations=8)
+    assert outs.shape[0] == 8
+    np.testing.assert_allclose(outs.asnumpy()[:5].ravel(), [0, 2, 4, 6, 8])
+    np.testing.assert_allclose(outs.asnumpy()[5:].ravel(), [0, 0, 0])
+    np.testing.assert_allclose(i.asnumpy(), [5.0])
+    np.testing.assert_allclose(s.asnumpy(), [10.0])
+
+
+def test_cond():
+    x = nd.array([3.0])
+    y = nd.array([4.0])
+    out = nd.contrib.cond(lambda a, b: (a < b).sum(),
+                          lambda a, b: a + b,
+                          lambda a, b: a - b, [x, y])
+    np.testing.assert_allclose(out.asnumpy(), [7.0])
+    out2 = nd.contrib.cond(lambda a, b: (a > b).sum(),
+                           lambda a, b: a + b,
+                           lambda a, b: a - b, [x, y])
+    np.testing.assert_allclose(out2.asnumpy(), [-1.0])
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+@mx.operator.register("sq_sum")
+class SqSumProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [[1]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class SqSum(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0]
+                self.assign(out_data[0], req[0], (x * x).sum().reshape((1,)))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                x = in_data[0]
+                g = out_grad[0]
+                self.assign(in_grad[0], req[0], 2.0 * x * g)
+
+        return SqSum()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sq_sum")
+    np.testing.assert_allclose(y.asnumpy(), [14.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_custom_op_registry():
+    assert "sq_sum" in mx.operator.get_all_registered_operators()

@@ -1,0 +1,137 @@
+"""Detection/CV op tests (reference spec:
+tests/python/unittest/test_contrib_operator.py box_nms/multibox tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_box_iou():
+    a = nd.array([[0, 0, 2, 2], [1, 1, 3, 3]])
+    b = nd.array([[0, 0, 2, 2], [10, 10, 11, 11]])
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, rtol=1e-4)
+    assert iou[0, 1] == 0.0
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [cls, score, x1, y1, x2, y2]
+    dets = nd.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 10.5, 10.5],   # overlaps the first -> suppressed
+        [0, 0.7, 20, 20, 30, 30],     # far away -> kept
+        [0, 0.05, 5, 5, 6, 6],        # below valid_thresh -> invalid
+    ])
+    out = nd.contrib.box_nms(dets, overlap_thresh=0.5, valid_thresh=0.1,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1].tolist()), [0.7, 0.9])
+
+
+def test_box_nms_class_aware():
+    dets = nd.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 1, 1, 10.5, 10.5],   # overlaps but different class
+    ])
+    out = nd.contrib.box_nms(dets, overlap_thresh=0.5, valid_thresh=0.0,
+                             coord_start=2, score_index=1, id_index=0,
+                             force_suppress=False).asnumpy()
+    assert (out[:, 0] >= 0).sum() == 2
+    out2 = nd.contrib.box_nms(dets, overlap_thresh=0.5, valid_thresh=0.0,
+                              coord_start=2, score_index=1, id_index=0,
+                              force_suppress=True).asnumpy()
+    assert (out2[:, 0] >= 0).sum() == 1
+
+
+def test_multibox_prior_shapes_and_values():
+    data = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                       ratios=(1, 2)).asnumpy()
+    # S + R - 1 = 3 anchors per cell
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    # first anchor centered at (.125, .125) with size .5
+    np.testing.assert_allclose(anchors[0, 0],
+                               [0.125 - 0.25, 0.125 - 0.25,
+                                0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+
+
+def test_multibox_target_matching():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]])
+    # one gt box matching anchor 0 exactly, class 3
+    label = nd.array([[[3, 0.0, 0.0, 0.5, 0.5],
+                       [-1, 0, 0, 0, 0]]])
+    cls_pred = nd.zeros((1, 5, 3))
+    loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred)
+    cls_t = cls_t.asnumpy()
+    assert cls_t[0, 0] == 4.0          # class + 1
+    assert cls_t[0, 1] == 0.0          # background
+    mask = loc_mask.asnumpy().reshape(1, 3, 4)
+    assert mask[0, 0].sum() == 4 and mask[0, 1].sum() == 0
+    # exact match -> zero offsets
+    lt = loc_t.asnumpy().reshape(1, 3, 4)
+    np.testing.assert_allclose(lt[0, 0], np.zeros(4), atol=1e-5)
+
+
+def test_multibox_detection_decodes():
+    anchors = nd.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9]]])
+    cls_prob = nd.array([[[0.1, 0.9],    # background prob
+                          [0.9, 0.05],   # class 0
+                          [0.0, 0.05]]])  # class 1
+    loc_pred = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.3).asnumpy()
+    valid = out[0][out[0, :, 0] >= 0]
+    assert valid.shape[0] == 1
+    assert valid[0, 0] == 0.0          # class id 0
+    np.testing.assert_allclose(valid[0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(valid[0, 2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_roi_align_identity():
+    # a 1x1 ROI over a constant region pools that constant
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]])
+    out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    # averages should increase along both axes
+    assert out[0, 0, 0, 0] < out[0, 0, 0, 1] < out[0, 0, 1, 1]
+
+
+def test_roi_pooling():
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]])
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    # max pooling of quadrants
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_proposal_shapes():
+    b, a, fh, fw = 1, 12, 4, 4  # 4 scales x 3 ratios
+    rs = np.random.RandomState(0)
+    cls_prob = nd.array(rs.rand(b, 2 * a, fh, fw).astype(np.float32))
+    bbox_pred = nd.array((rs.rand(b, 4 * a, fh, fw) * 0.1).astype(np.float32))
+    im_info = nd.array([[64, 64, 1.0]])
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                               feature_stride=16).asnumpy()
+    assert rois.shape == (10, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all() and (rois[:, 1:] <= 63).all()
+
+
+def test_bipartite_matching():
+    scores = nd.array([[0.9, 0.1], [0.8, 0.7]])
+    row, col = nd.contrib.bipartite_matching(scores, threshold=0.5)
+    row, col = row.asnumpy(), col.asnumpy()
+    assert row[0] == 0          # row 0 takes col 0 (0.9)
+    assert row[1] == 1          # row 1 falls back to col 1 (0.7)
+    assert col[0] == 0 and col[1] == 1
