@@ -37,13 +37,6 @@ class ImageRecordIter(DataIter):
         super().__init__(batch_size)
         if path_imgrec is None or data_shape is None:
             raise MXNetError("path_imgrec and data_shape are required")
-        from .. import recordio
-
-        if path_imgidx is None:
-            path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
-        self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-        if not self._rec.keys:
-            raise MXNetError(f"{path_imgidx}: empty or missing index")
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._shuffle = shuffle
@@ -64,6 +57,38 @@ class ImageRecordIter(DataIter):
                                        (batch_size, label_width))]
         self._queue: Optional[queue.Queue] = None
         self._workers: List[threading.Thread] = []
+
+        # prefer the native C++ pipeline (src/mxio.cc) when built —
+        # reference parity with iter_image_recordio_2.cc's threaded parser
+        self._native = None
+        from . import native as _native_mod
+
+        if _native_mod.available() and dtype == "float32":
+            try:
+                self._native = _native_mod.NativeImageIter(
+                    path_imgrec, batch_size, self.data_shape,
+                    preprocess_threads=self._threads, shuffle=shuffle,
+                    seed=seed, resize=resize, rand_crop=rand_crop,
+                    rand_mirror=rand_mirror, scale=scale,
+                    mean=self._mean, std=self._std,
+                    label_width=label_width, prefetch=self._prefetch)
+                self._native_batches = (
+                    self._native.num_records // batch_size
+                    if round_batch else
+                    (self._native.num_records + batch_size - 1) // batch_size)
+                self._consumed = 0
+                return
+            except RuntimeError:
+                self._native = None
+
+        # pure Python fallback needs the indexed record file
+        from .. import recordio
+
+        if path_imgidx is None:
+            path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+        self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        if not self._rec.keys:
+            raise MXNetError(f"{path_imgidx}: empty or missing index")
         self._start_epoch()
 
     # ------------------------------------------------------------------
@@ -146,14 +171,33 @@ class ImageRecordIter(DataIter):
 
     # ------------------------------------------------------------------
     def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            self._consumed = 0
+            return
         self._start_epoch()
 
     def iter_next(self):
+        if self._native is not None:
+            return self._consumed < self._native_batches
         return self._consumed < len(self._batches)
 
     def next(self):
         from .. import ndarray as nd
 
+        if self._native is not None:
+            if self._consumed >= self._native_batches:
+                raise StopIteration
+            out = self._native.next_batch()
+            if out is None:
+                raise StopIteration
+            data, labels = out
+            self._consumed += 1
+            return DataBatch(
+                data=[nd.array(data, dtype=self._dtype)],
+                label=[nd.array(labels)],
+                pad=0, provide_data=self.provide_data,
+                provide_label=self.provide_label)
         if self._consumed >= len(self._batches):
             raise StopIteration
         _, data, labels = self._queue.get()

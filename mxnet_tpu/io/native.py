@@ -1,0 +1,132 @@
+"""ctypes binding for libmxio.so — the native RecordIO image pipeline.
+
+Reference parity: the C ABI role of src/c_api for the IO subsystem
+(MXDataIterCreateIter -> iter_image_recordio_2.cc); here a narrow dedicated
+boundary (SURVEY.md §7.1: "keep a narrow libmx_io C++ boundary").
+
+The library is built by `make -C src` (no pybind11 in this image — plain
+ctypes over an extern-C ABI).  `available()` gates every use so the pure
+Python pipeline remains the fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "lib",
+                         "libmxio.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("MXNET_USE_NATIVE_IO", "1") == "0":
+        return None
+    try:
+        lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+    except OSError:
+        return None
+    lib.MXIOImageIterCreate.restype = ctypes.c_void_p
+    lib.MXIOImageIterCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float]
+    lib.MXIOImageIterNext.restype = ctypes.c_int
+    lib.MXIOImageIterNext.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.POINTER(ctypes.c_float)]
+    lib.MXIOImageIterReset.argtypes = [ctypes.c_void_p]
+    lib.MXIOImageIterNumRecords.restype = ctypes.c_longlong
+    lib.MXIOImageIterNumRecords.argtypes = [ctypes.c_void_p]
+    lib.MXIOImageIterDestroy.argtypes = [ctypes.c_void_p]
+    lib.MXIOEncodeJpeg.restype = ctypes.c_int
+    lib.MXIOEncodeJpeg.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeImageIter:
+    """Thin wrapper owning one native iterator handle."""
+
+    def __init__(self, path_imgrec: str, batch_size: int, data_shape,
+                 preprocess_threads=4, shuffle=False, seed=0, resize=0,
+                 rand_crop=False, rand_mirror=False, scale=1.0,
+                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), label_width=1,
+                 prefetch=2, brightness=0.0, contrast=0.0, saturation=0.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libmxio.so not available (make -C src)")
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
+        self._lib = lib
+        self._handle = lib.MXIOImageIterCreate(
+            path_imgrec.encode(), batch_size, c, h, w,
+            int(preprocess_threads), int(bool(shuffle)), int(seed),
+            int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
+            float(scale), mean_arr, std_arr, int(label_width), int(prefetch),
+            float(brightness), float(contrast), float(saturation))
+        if not self._handle:
+            raise RuntimeError(f"native iter failed to open {path_imgrec}")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+
+    @property
+    def num_records(self) -> int:
+        return int(self._lib.MXIOImageIterNumRecords(self._handle))
+
+    def next_batch(self):
+        """Returns (data NCHW float32, labels) or None at epoch end."""
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        labels = np.empty((self.batch_size, self.label_width), np.float32)
+        ok = self._lib.MXIOImageIterNext(
+            self._handle,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not ok:
+            return None
+        return data, labels
+
+    def reset(self):
+        self._lib.MXIOImageIterReset(self._handle)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.MXIOImageIterDestroy(handle)
+            self._handle = None
+
+
+def encode_jpeg(rgb: np.ndarray, quality: int = 95) -> bytes:
+    """JPEG-encode an RGB uint8 HWC array via the native lib."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libmxio.so not available")
+    rgb = np.ascontiguousarray(rgb, np.uint8)
+    h, w = rgb.shape[:2]
+    cap = h * w * 3 + 1024
+    out = (ctypes.c_ubyte * cap)()
+    n = lib.MXIOEncodeJpeg(
+        rgb.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), h, w,
+        int(quality), out, cap)
+    if n < 0:
+        raise RuntimeError("jpeg encode failed")
+    return bytes(out[:n])

@@ -173,13 +173,9 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
 
         carry0 = (jnp.asarray(False), jnp.asarray(0, jnp.int32),
                   tuple(arrays))
-        import jax
-
         (done, count, final), stacked = jax.lax.scan(
             step, carry0, None, length=int(max_iterations))
         return tuple(stacked) + tuple(final)
-
-    import jax.numpy as jnp  # noqa: F401  (used inside fn)
 
     results = _as_list(_reg.invoke_fn(fn, loop_list))
     n_out = len(results) - n_vars

@@ -14,8 +14,6 @@ kernels).
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -231,13 +229,16 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         matched = best_iou >= overlap_threshold
 
         # stage 1: force-match the best anchor of each gt (reference
-        # two-stage matching)
+        # two-stage matching).  Invalid (padded) gt rows must not scatter:
+        # route their writes to an out-of-range index (mode='drop'), else a
+        # padded row colliding on anchor 0 clobbers a real match.
         best_anchor = jnp.argmax(ious, axis=0)       # per gt (M,)
+        gt_usable = gt_valid & (jnp.max(ious, axis=0) > 1e-6)
+        scatter_idx = jnp.where(gt_usable, best_anchor, n)
         forced = jnp.zeros((n,), bool)
-        forced = forced.at[best_anchor].set(gt_valid
-                                            & (jnp.max(ious, 0) > 1e-6))
-        best_gt = best_gt.at[best_anchor].set(
-            jnp.where(gt_valid, jnp.arange(lab.shape[0]), best_gt[best_anchor]))
+        forced = forced.at[scatter_idx].set(True, mode="drop")
+        best_gt = best_gt.at[scatter_idx].set(
+            jnp.arange(lab.shape[0]), mode="drop")
         matched = matched | forced
 
         m_gt = gt_boxes[best_gt]  # (N, 4)
@@ -395,10 +396,12 @@ def _roi_pool_impl(data, rois, pooled_size, spatial_scale, mode):
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
-        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
-        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
-        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        # clamp the ROI to the feature map (reference behavior) so no
+        # pooling bin is ever empty
+        x1 = jnp.clip(jnp.round(roi[1] * spatial_scale), 0, w - 1).astype(jnp.int32)
+        y1 = jnp.clip(jnp.round(roi[2] * spatial_scale), 0, h - 1).astype(jnp.int32)
+        x2 = jnp.clip(jnp.round(roi[3] * spatial_scale), 0, w - 1).astype(jnp.int32)
+        y2 = jnp.clip(jnp.round(roi[4] * spatial_scale), 0, h - 1).astype(jnp.int32)
         rw = jnp.maximum(x2 - x1 + 1, 1)
         rh = jnp.maximum(y2 - y1 + 1, 1)
         img = data[bidx]
@@ -413,7 +416,8 @@ def _roi_pool_impl(data, rois, pooled_size, spatial_scale, mode):
             mask = ((ys[:, None] >= cy1) & (ys[:, None] < cy2)
                     & (xs[None, :] >= cx1) & (xs[None, :] < cx2))
             vals = jnp.where(mask[None], img, -jnp.inf)
-            return jnp.max(vals, axis=(1, 2))
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)  # empty bin -> 0
 
         out = jax.vmap(lambda iy: jax.vmap(lambda ix: cell(iy, ix))(
             jnp.arange(pw)))(jnp.arange(ph))

@@ -135,3 +135,28 @@ def test_bipartite_matching():
     assert row[0] == 0          # row 0 takes col 0 (0.9)
     assert row[1] == 1          # row 1 falls back to col 1 (0.7)
     assert col[0] == 0 and col[1] == 1
+
+
+def test_multibox_target_padded_labels():
+    """Padded -1 label rows must not clobber forced matches (regression)."""
+    anchors = nd.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0]]])
+    # gt overlaps anchor 0 with IoU < 0.5 -> only the forced match applies;
+    # second row is padding
+    label = nd.array([[[2, 0.0, 0.0, 0.3, 0.55],
+                       [-1, 0, 0, 0, 0]]])
+    cls_pred = nd.zeros((1, 4, 2))
+    loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    assert cls_t.asnumpy()[0, 0] == 3.0  # class 2 + 1, forced match kept
+    assert loc_mask.asnumpy().sum() == 4.0
+
+
+def test_roi_pooling_out_of_bounds():
+    """ROIs beyond the feature map clamp instead of producing -inf."""
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 2, 2, 7, 7]])
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    assert np.isfinite(out).all()
+    assert out.max() == 15.0

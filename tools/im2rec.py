@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""im2rec: pack an image dataset into RecordIO (.rec + .idx).
+
+Reference parity: tools/im2rec.py + tools/im2rec.cc (OpenCV encode ->
+RecordIO packer, multithreaded ~L1-400).  Usage mirrors the reference:
+
+  # make a list file (label = class-subdirectory index)
+  python tools/im2rec.py --list data/train data/imgs --recursive
+
+  # pack it
+  python tools/im2rec.py data/train data/imgs --resize 256 --quality 95 \
+      --num-thread 8
+
+List format (tab-separated): index \t label... \t relative_path
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, recursive=False, train_ratio=1.0, chunks=1):
+    entries = []
+    if recursive:
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        entries.append((float(label), rel))
+        print(f"{len(classes)} classes: {classes}")
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                entries.append((0.0, f))
+
+    import random
+
+    random.Random(0).shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    splits = [("", entries[:n_train])]
+    if train_ratio < 1.0:
+        splits = [("_train", entries[:n_train]), ("_val", entries[n_train:])]
+    for suffix, ents in splits:
+        path = f"{prefix}{suffix}.lst"
+        with open(path, "w") as f:
+            for i, (label, rel) in enumerate(ents):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {len(ents)} entries to {path}")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, num_thread=4, color=1,
+         encoding=".jpg"):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imread, imresize
+
+    lst = list(read_list(prefix + ".lst"))
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+
+    def encode_one(item):
+        idx, labels, rel = item
+        import numpy as np
+
+        img = imread(os.path.join(root, rel), to_ndarray=False)
+        if resize:
+            h, w = img.shape[:2]
+            if min(h, w) != resize:
+                s = resize / min(h, w)
+                img = imresize(img, int(round(w * s)), int(round(h * s)))
+        header = recordio.IRHeader(
+            flag=len(labels) if len(labels) > 1 else 0,
+            label=(labels if len(labels) > 1 else labels[0]),
+            id=idx, id2=0)
+        return idx, recordio.pack_img(header, img, quality=quality,
+                                      img_fmt=encoding)
+
+    with ThreadPoolExecutor(max_workers=num_thread) as pool:
+        for i, (idx, payload) in enumerate(pool.map(encode_one, lst)):
+            record.write_idx(idx, payload)
+            if (i + 1) % 1000 == 0:
+                print(f"packed {i + 1}/{len(lst)}")
+    record.close()
+    print(f"wrote {len(lst)} records to {prefix}.rec")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="label images by class subdirectory")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side before encoding")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--num-thread", type=int, default=4)
+    ap.add_argument("--encoding", default=".jpg")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        make_list(args.prefix, args.root, recursive=args.recursive,
+                  train_ratio=args.train_ratio)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, num_thread=args.num_thread,
+             encoding=args.encoding)
+
+
+if __name__ == "__main__":
+    main()
