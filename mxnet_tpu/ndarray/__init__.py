@@ -20,6 +20,8 @@ from .ndarray import NDArray, array, from_jax
 from . import random  # noqa: F401  (nd.random namespace)
 from .utils import save, load
 from . import contrib  # noqa: F401  (nd.contrib namespace)
+from . import sparse  # noqa: F401  (nd.sparse namespace)
+from .sparse import RowSparseNDArray, CSRNDArray
 from ..operator import Custom  # noqa: F401  (mx.nd.Custom)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",

@@ -185,12 +185,15 @@ class NDArray:
         return self
 
     def tostype(self, stype: str):
-        if stype != "default":
-            raise MXNetError(
-                "sparse storage types are emulated at the frontend; see "
-                "mxnet_tpu.ndarray.sparse"
-            )
-        return self
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+
+        if stype == "row_sparse":
+            return _sparse.row_sparse_array(self)
+        if stype == "csr":
+            return _sparse.csr_matrix(self)
+        raise MXNetError(f"unknown storage type {stype!r}")
 
     # ------------------------------------------------------------------
     # autograd hooks

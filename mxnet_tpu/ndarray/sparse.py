@@ -1,0 +1,300 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference parity: python/mxnet/ndarray/sparse.py (RowSparseNDArray,
+CSRNDArray, row_sparse_array, csr_matrix) over src/ndarray/ndarray.cc
+storage types (include/mxnet/ndarray.h NDArrayStorageType ~L60) and the
+FComputeEx sparse kernels in src/operator/tensor/.
+
+TPU-native design (SURVEY §7.3 #8): XLA has no sparse tensors, so sparse
+storage lives at the NDArray layer as (values, indices[, indptr]) component
+arrays; compute lowers to dense gathers/scatters and segment ops, which XLA
+maps well onto the TPU's gather/scatter units.  row_sparse keeps its key
+role from the reference — compact gradients for Embedding-style lookups and
+the optimizers' lazy row-wise updates (optimizer sparse paths consume the
+(indices, values) pair directly, exactly like the reference's
+sgd_update(row_sparse) kernels).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "array", "empty"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior for the compressed storage types."""
+
+    # NDArray.__slots__ covers _data/_ctx/...; sparse adds component arrays
+    __slots__ = ("_aux", "_shape")
+
+    def __init__(self, data, aux: dict, shape: Tuple[int, ...], ctx=None):
+        super().__init__(data, ctx=ctx)
+        self._aux = aux
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def _num_aux(self):
+        return len(self._aux)
+
+    @property
+    def data(self):
+        """The values component (reference: .data attribute)."""
+        return NDArray(self._data, ctx=self._ctx)
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._shape} "
+                f"ctx={self._ctx}>")
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self.todense()._data)
+
+    def astype(self, dtype, copy: bool = True):
+        out = self.todense().astype(dtype)
+        return out
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        return self.todense().tostype(stype)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            import jax
+
+            aux = {k: jax.device_put(v, other.jax_device)
+                   for k, v in self._aux.items()}
+            return type(self)._from_components(
+                jax.device_put(self._data, other.jax_device), aux,
+                self._shape, other)
+        return super().copyto(other)
+
+    def __getitem__(self, key):
+        return self.todense()[key]
+
+    def __setitem__(self, key, value):
+        raise MXNetError(f"{type(self).__name__} does not support "
+                         "item assignment; convert with tostype('default')")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at `indices` hold `values`; all other rows are zero
+    (reference: RowSparseNDArray — the gradient type of sparse Embedding)."""
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"], ctx=self._ctx)
+
+    @classmethod
+    def _from_components(cls, values, aux, shape, ctx):
+        return cls(values, dict(aux), shape, ctx=ctx)
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+
+        def fn():
+            out = jnp.zeros(self._shape, self._data.dtype)
+            return out.at[self._aux["indices"]].set(self._data)
+
+        return NDArray(fn(), ctx=self._ctx)
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        """Keep only the given rows (reference: sparse.retain)."""
+        import jax.numpy as jnp
+
+        keep = indices._data.astype(jnp.int64) if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int64)
+        mine = self._aux["indices"]
+        # membership of my rows in `keep`
+        hit = (mine[:, None] == keep[None, :]).any(axis=1)
+        # gather values for keep-rows present in mine (zero rows otherwise)
+        pos = jnp.argmax(mine[:, None] == keep[None, :], axis=0)
+        present = (mine[pos] == keep)
+        vals = jnp.where(present[:, None],
+                         self._data[pos], jnp.zeros_like(self._data[pos]))
+        del hit
+        return RowSparseNDArray(vals, {"indices": keep.astype(mine.dtype)},
+                                self._shape, ctx=self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: CSRNDArray)."""
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"], ctx=self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._aux["indptr"], ctx=self._ctx)
+
+    @classmethod
+    def _from_components(cls, values, aux, shape, ctx):
+        return cls(values, dict(aux), shape, ctx=ctx)
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+
+        m, n = self._shape
+        indptr = self._aux["indptr"]
+        indices = self._aux["indices"]
+        nnz = self._data.shape[0]
+        # row id per nonzero: searchsorted over indptr
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        out = jnp.zeros((m, n), self._data.dtype)
+        out = out.at[rows, indices].set(self._data)
+        return NDArray(out, ctx=self._ctx)
+
+    def dot(self, dense: NDArray, transpose_a: bool = False) -> NDArray:
+        """csr @ dense via gather + segment-sum (reference: dot(csr, dense)
+        FComputeEx; TPU mapping: segment_sum vectorizes on the VPU)."""
+        import jax
+        import jax.numpy as jnp
+
+        m, n = self._shape
+        indptr = self._aux["indptr"]
+        indices = self._aux["indices"]
+        nnz = self._data.shape[0]
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        gathered = dense._data[indices] * self._data[:, None]
+        if transpose_a:
+            # csr.T @ dense: scatter-add contributions into column slots
+            out = jax.ops.segment_sum(
+                dense._data[rows] * self._data[:, None], indices,
+                num_segments=n)
+            return NDArray(out, ctx=self._ctx)
+        out = jax.ops.segment_sum(gathered, rows, num_segments=m)
+        return NDArray(out, ctx=self._ctx)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: sparse.row_sparse_array / csr_matrix)
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        import jax.numpy as jnp
+
+        values = _component(data, dtype)
+        idx = _component(indices, "int64")
+        if shape is None:
+            raise MXNetError("row_sparse_array requires shape with "
+                             "(data, indices)")
+        return RowSparseNDArray(values, {"indices": idx}, tuple(shape),
+                                ctx=ctx)
+    # dense input -> compress
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(arg1, ctx=ctx)
+    arr = np.asarray(dense.asnumpy())
+    nz_rows = np.where(np.any(arr != 0, axis=tuple(range(1, arr.ndim))))[0]
+    return RowSparseNDArray(
+        _component(arr[nz_rows], dtype), {"indices": _component(nz_rows, "int64")},
+        arr.shape, ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("csr_matrix requires shape with "
+                             "(data, indices, indptr)")
+        return CSRNDArray(
+            _component(data, dtype),
+            {"indices": _component(indices, "int64"),
+             "indptr": _component(indptr, "int64")}, tuple(shape), ctx=ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(arg1, ctx=ctx)
+    arr = np.asarray(dense.asnumpy())
+    if arr.ndim != 2:
+        raise MXNetError("csr_matrix requires a 2-D input")
+    rows, cols = np.nonzero(arr)
+    indptr = np.zeros(arr.shape[0] + 1, np.int64)
+    np.add.at(indptr[1:], rows, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(
+        _component(arr[rows, cols], dtype),
+        {"indices": _component(cols, "int64"),
+         "indptr": _component(indptr, "int64")}, arr.shape, ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    dtype = np.dtype(dtype_np(dtype)).name
+    import jax.numpy as jnp
+
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype),
+            {"indices": jnp.zeros((0,), jnp.int64)}, tuple(shape), ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(
+            jnp.zeros((0,), dtype),
+            {"indices": jnp.zeros((0,), jnp.int64),
+             "indptr": jnp.zeros((shape[0] + 1,), jnp.int64)},
+            tuple(shape), ctx=ctx)
+    if stype == "default":
+        from . import zeros as dense_zeros
+
+        return dense_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-preserving array(): scipy.sparse and sparse NDArrays keep
+    their storage type (reference: sparse.array)."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array
+    try:
+        import scipy.sparse as sp
+
+        if sp.issparse(source_array):
+            csr = source_array.tocsr()
+            return csr_matrix((csr.data, csr.indices, csr.indptr),
+                              shape=csr.shape, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    raise MXNetError("sparse.array expects a scipy.sparse matrix or sparse "
+                     "NDArray; use nd.array for dense inputs")
+
+
+def _component(x, dtype):
+    import jax.numpy as jnp
+
+    if isinstance(x, NDArray):
+        arr = x._data
+    else:
+        arr = jnp.asarray(np.asarray(x))
+    if dtype is not None:
+        arr = arr.astype(dtype_np(dtype) if dtype != "int64" else np.int64)
+    return arr
