@@ -58,6 +58,13 @@ def _bfloat16():
     return np.dtype(ml_dtypes.bfloat16)
 
 
+def is_float_dtype(dtype: Any) -> bool:
+    """True for any floating dtype INCLUDING ml_dtypes floats (bfloat16
+    reports numpy kind 'V', so dtype.kind == 'f' checks are wrong — the
+    BENCH_r02 crash class).  Single source of truth for this check."""
+    return np.dtype(dtype).kind in ("f", "V")
+
+
 def dtype_np(dtype: Any) -> np.dtype:
     """Normalize a user-supplied dtype (string, np.dtype, type) to np.dtype."""
     if dtype is None:

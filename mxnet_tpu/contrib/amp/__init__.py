@@ -5,10 +5,9 @@ convert_model/convert_hybrid_block ~L500; op lists in lists/symbol_fp16.py).
 TPU-native policy (SURVEY §2.3 mixed-precision row): the working dtype is
 bfloat16 — same exponent range as fp32, so **no loss scaling is needed**;
 the scale_loss API is kept (scale 1.0) so reference training scripts run
-unchanged.  Matmuls/convs already accumulate in fp32
-(preferred_element_type in ops/nn.py), which is the MXNET_SAFE_ACCUMULATION
-behavior by default.  fp16 is supported with a real DynamicLossScaler for
-API completeness.
+unchanged.  bf16 matmuls/convs accumulate in fp32 natively on the TPU MXU,
+which is the MXNET_SAFE_ACCUMULATION behavior by default.  fp16 is
+supported with a real DynamicLossScaler for API completeness.
 """
 from .amp import (init, init_trainer, scale_loss, unscale,
                   convert_hybrid_block, convert_model, DynamicLossScaler)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, is_float_dtype
 from .registry import register
 
 
@@ -37,12 +37,14 @@ def fully_connected(data, weight, *bias, num_hidden=None, no_bias=False, flatten
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    # contract input_dim; keep bf16 inputs on the MXU with f32 accumulation
+    # contract input_dim.  No explicit preferred_element_type: the TPU MXU
+    # accumulates bf16 matmuls in f32 natively, and an explicit f32 output +
+    # astype breaks the transpose rules under value_and_grad (the cotangent
+    # arrives f32 against bf16 saved operands — the BENCH_r02 failure mode).
     y = jax.lax.dot_general(
         x, weight,
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    )
     if not no_bias and bias:
         y = y + bias[0]
     return y
@@ -76,8 +78,7 @@ def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not no_bias and bias:
         b = bias[0].reshape((1, -1) + (1,) * n)
         out = out + b
@@ -117,8 +118,7 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not no_bias and bias:
         out = out + bias[0].reshape((1, -1) + (1,) * n)
     return out
@@ -155,22 +155,32 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
     else:
         pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
 
+    # dtype-safe identities: bfloat16 (ml_dtypes) reports numpy kind 'V',
+    # so go through jnp.issubdtype rather than dtype.kind (the BENCH_r02
+    # crash).  The identities must be HOST numpy scalars — lax only
+    # recognizes the max/add monoid (and thus differentiates the window
+    # reduce) for literal init values, not traced jnp constants.
+    dt = np.dtype(data.dtype)
     if pool_type == "max":
-        init = -jnp.inf if data.dtype.kind == "f" else jnp.iinfo(data.dtype).min
+        if is_float_dtype(dt):
+            init = np.array(-np.inf, dt)
+        else:
+            init = np.array(np.iinfo(dt).min, dt)
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+    zero = np.zeros((), dt)
     if pool_type in ("avg", "sum"):
-        summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+        summed = jax.lax.reduce_window(data, zero, jax.lax.add, window, strides, pads)
         if pool_type == "sum":
             return summed
         if count_include_pad:
             denom = float(np.prod(kernel))
             return summed / denom
         ones = jnp.ones_like(data)
-        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        counts = jax.lax.reduce_window(ones, zero, jax.lax.add, window, strides, pads)
         return summed / counts
     if pool_type == "lp":
         powed = jax.lax.reduce_window(
-            jnp.abs(data) ** p_value, 0.0, jax.lax.add, window, strides, pads
+            jnp.abs(data) ** p_value, zero, jax.lax.add, window, strides, pads
         )
         return powed ** (1.0 / p_value)
     raise MXNetError(f"pool_type {pool_type}")
@@ -295,7 +305,7 @@ def _make_softmax_output(grad_scale, ignore_label, use_ignore, multi_output,
             scale = grad_scale / valid
         elif normalization == "batch":
             scale = grad_scale / out.shape[0]
-        if label.dtype.kind == "f":
+        if is_float_dtype(label.dtype):  # incl. bfloat16 (numpy kind 'V')
             lab_ct = jnp.zeros_like(label)
         else:  # integer labels: jax requires a float0 cotangent
             lab_ct = np.zeros(label.shape, dtype=jax.dtypes.float0)

@@ -26,17 +26,44 @@ def enabled() -> bool:
     return os.environ.get("MXNET_USE_FUSION", "1") not in ("0", "false")
 
 
+_platform_override = None  # set via compute_on() while tracing for a mesh
+
+
 def use_compiled() -> bool:
     """True when Pallas kernels should lower through Mosaic (TPU backend).
 
     Single source of truth for call-site gates: kernels run interpreted
     exactly when this is False, so a gate that checks `enabled() and
     use_compiled()` can never disagree with the kernels' interpret flag.
+
+    Keyed off the platform the computation will actually run on — an
+    explicit `compute_on(...)` override (set by DataParallelStep/dryrun
+    when jitting over a mesh) wins over the process default backend, so a
+    CPU mesh under a TPU default backend correctly gets interpret mode.
     """
     import jax
 
-    return jax.default_backend() == "tpu"
+    platform = _platform_override or jax.default_backend()
+    return platform == "tpu"
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def compute_on(platform: str):
+    """Scope within which Pallas kernels lower for `platform` ('cpu'/'tpu').
+
+    Used at trace time (the interpret flag is baked into pallas_call when
+    the enclosing jit traces)."""
+    global _platform_override
+    prev = _platform_override
+    _platform_override = platform
+    try:
+        yield
+    finally:
+        _platform_override = prev
 
 
 __all__ = ["flash_attention", "softmax_cross_entropy", "layer_norm",
-           "enabled"]
+           "enabled", "use_compiled", "compute_on"]

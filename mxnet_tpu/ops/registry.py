@@ -92,9 +92,9 @@ def _is_tracer(x) -> bool:
 
 
 def _is_float(arr) -> bool:
-    import numpy as np
+    from ..base import is_float_dtype
 
-    return arr.dtype.kind in ("f", "V")  # V: bfloat16 shows as void in old numpy
+    return is_float_dtype(arr.dtype)
 
 
 def invoke(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):

@@ -263,8 +263,15 @@ class DataParallelStep:
         data_arr = jax.device_put(data_arr, dsh)
         label_arr = jax.device_put(label_arr, lsh)
         key = _random.next_key()
-        self.params, self.opt_state, loss = self._jitted(
-            self.params, self.opt_state, key, data_arr, label_arr)
+        # Pallas kernels must lower for the platform the MESH runs on (a CPU
+        # mesh under a TPU default backend needs interpret mode); the flag is
+        # baked in at trace time, so scope the override around the jit call.
+        from ..ops import pallas as _pk
+
+        mesh_platform = next(iter(self.mesh.devices.flat)).platform
+        with _pk.compute_on(mesh_platform):
+            self.params, self.opt_state, loss = self._jitted(
+                self.params, self.opt_state, key, data_arr, label_arr)
         self._step_count += 1
         return loss
 
