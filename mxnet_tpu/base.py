@@ -58,11 +58,34 @@ def _bfloat16():
     return np.dtype(ml_dtypes.bfloat16)
 
 
+_ML_FLOAT_DTYPES = None
+
+
 def is_float_dtype(dtype: Any) -> bool:
     """True for any floating dtype INCLUDING ml_dtypes floats (bfloat16
     reports numpy kind 'V', so dtype.kind == 'f' checks are wrong — the
-    BENCH_r02 crash class).  Single source of truth for this check."""
-    return np.dtype(dtype).kind in ("f", "V")
+    BENCH_r02 crash class).  Single source of truth for this check.
+
+    Matches the explicit ml_dtypes float set rather than all of kind 'V'
+    (which would also claim int4/structured dtypes are floats)."""
+    global _ML_FLOAT_DTYPES
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return True
+    if _ML_FLOAT_DTYPES is None:
+        import ml_dtypes
+
+        found = set()
+        for name in ("bfloat16", "float8_e4m3", "float8_e4m3fn",
+                     "float8_e4m3b11_fnuz", "float8_e4m3fnuz",
+                     "float8_e5m2", "float8_e5m2fnuz", "float8_e3m4",
+                     "float8_e8m0fnu", "float4_e2m1fn", "float6_e2m3fn",
+                     "float6_e3m2fn"):
+            t = getattr(ml_dtypes, name, None)
+            if t is not None:
+                found.add(np.dtype(t))
+        _ML_FLOAT_DTYPES = found
+    return dt in _ML_FLOAT_DTYPES
 
 
 def dtype_np(dtype: Any) -> np.dtype:

@@ -25,6 +25,17 @@ def _pair(v, n):
     return tuple(v)
 
 
+def _safe_acc(data, weight):
+    """fp16 safe accumulation: fp16 partial sums overflow at ~65504, so
+    matmul/conv inputs are upcast to f32 (MXNET_SAFE_ACCUMULATION).  The
+    upcast-inputs pattern (not preferred_element_type) keeps the transpose
+    rules dtype-consistent under value_and_grad.  bf16 needs nothing: the
+    MXU accumulates bf16 in f32 natively."""
+    if np.dtype(data.dtype) == np.float16:
+        return data.astype(jnp.float32), weight.astype(jnp.float32), True
+    return data, weight, False
+
+
 # ---------------------------------------------------------------------------
 # dense / conv
 # ---------------------------------------------------------------------------
@@ -37,14 +48,16 @@ def fully_connected(data, weight, *bias, num_hidden=None, no_bias=False, flatten
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    # contract input_dim.  No explicit preferred_element_type: the TPU MXU
-    # accumulates bf16 matmuls in f32 natively, and an explicit f32 output +
-    # astype breaks the transpose rules under value_and_grad (the cotangent
-    # arrives f32 against bf16 saved operands — the BENCH_r02 failure mode).
+    # No explicit preferred_element_type: an f32 output + astype breaks the
+    # transpose rules under value_and_grad (the cotangent arrives f32
+    # against bf16 saved operands — the BENCH_r02 failure mode).
+    x, w, downcast = _safe_acc(x, weight)
     y = jax.lax.dot_general(
-        x, weight,
+        x, w,
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
     )
+    if downcast:
+        y = y.astype(data.dtype)
     if not no_bias and bias:
         y = y + bias[0]
     return y
@@ -71,14 +84,17 @@ def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         data.shape, weight.shape,
         ("NC" + spatial, "OI" + spatial, "NC" + spatial),
     )
+    lhs, rhs, downcast = _safe_acc(data, weight)
     out = jax.lax.conv_general_dilated(
-        data, weight,
+        lhs, rhs,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
     )
+    if downcast:
+        out = out.astype(data.dtype)
     if not no_bias and bias:
         b = bias[0].reshape((1, -1) + (1,) * n)
         out = out + b
@@ -110,8 +126,9 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         lo = k - 1 - pad[i]
         hi = k - 1 - pad[i] + adj[i]
         pads.append((lo, hi))
+    lhs, rhs, downcast = _safe_acc(data, weight)
     out = jax.lax.conv_general_dilated(
-        data, weight,
+        lhs, rhs,
         window_strides=(1,) * n,
         padding=pads,
         lhs_dilation=stride,
@@ -119,6 +136,8 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         dimension_numbers=dn,
         feature_group_count=num_group,
     )
+    if downcast:
+        out = out.astype(data.dtype)
     if not no_bias and bias:
         out = out + bias[0].reshape((1, -1) + (1,) * n)
     return out

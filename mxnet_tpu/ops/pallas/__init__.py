@@ -26,7 +26,12 @@ def enabled() -> bool:
     return os.environ.get("MXNET_USE_FUSION", "1") not in ("0", "false")
 
 
-_platform_override = None  # set via compute_on() while tracing for a mesh
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+# per-context so concurrent steps on meshes of different platforms can't
+# bake each other's interpret flag into a traced kernel
+_platform_override: ContextVar = ContextVar("pallas_platform", default=None)
 
 
 def use_compiled() -> bool:
@@ -43,11 +48,8 @@ def use_compiled() -> bool:
     """
     import jax
 
-    platform = _platform_override or jax.default_backend()
+    platform = _platform_override.get() or jax.default_backend()
     return platform == "tpu"
-
-
-from contextlib import contextmanager
 
 
 @contextmanager
@@ -56,13 +58,11 @@ def compute_on(platform: str):
 
     Used at trace time (the interpret flag is baked into pallas_call when
     the enclosing jit traces)."""
-    global _platform_override
-    prev = _platform_override
-    _platform_override = platform
+    token = _platform_override.set(platform)
     try:
         yield
     finally:
-        _platform_override = prev
+        _platform_override.reset(token)
 
 
 __all__ = ["flash_attention", "softmax_cross_entropy", "layer_norm",

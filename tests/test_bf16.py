@@ -127,6 +127,18 @@ def test_softmax_output_bf16_label_grad():
     assert np.isfinite(x.grad.asnumpy().astype(np.float32)).all()
 
 
+def test_fp16_safe_accumulation():
+    # MXNET_SAFE_ACCUMULATION: naive fp16 accumulation of 4096 ones stalls
+    # at 2048 (fp16 integers are exact only to 2048; beyond, +1 rounds
+    # away), while f32 accumulation gives exactly 4096 — which still fits
+    # fp16.
+    x = nd.array(np.ones((2, 4096), np.float16), dtype=np.float16)
+    w = nd.array(np.ones((3, 4096), np.float16), dtype=np.float16)
+    y = nd.FullyConnected(x, w, num_hidden=3, no_bias=True)
+    v = y.asnumpy().astype(np.float64)
+    np.testing.assert_allclose(v, np.full((2, 3), 4096.0), rtol=1e-3)
+
+
 def test_hybridized_bf16_matches_eager():
     mx.random.seed(0)
     net = _tiny_convnet()

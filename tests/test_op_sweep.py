@@ -1,0 +1,445 @@
+"""Registry-wide operator correctness sweep.
+
+The reference's oracle discipline (tests/python/unittest/test_operator.py
+~10k lines: check_numeric_gradient + numpy-forward per op;
+tests/python/gpu/test_operator_gpu.py: check_consistency across
+device/dtype) applied to this registry, per SURVEY §4.4:
+
+  * forward vs a numpy reference (where one is cheap to state);
+  * analytic gradient (autograd tape -> jax.vjp) vs central finite
+    differences, through a fixed random projection so reductions in the
+    op can't hide gradient structure;
+  * a bfloat16 sweep: every case re-runs forward in bf16 against the f32
+    result (dtype-aware tolerance) and, when differentiable, backward in
+    bf16 asserting finite grads — this is the class of test whose absence
+    let the round-2 bf16 bugs ship.
+
+Shapes are tiny (<= ~36 elements) so the per-element FD loop stays fast.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+BF16 = ml_dtypes.bfloat16
+
+
+@dataclasses.dataclass
+class Case:
+    id: str
+    fn: Callable  # (*NDArray) -> NDArray or list of NDArray
+    shapes: Sequence[Tuple[int, ...]]
+    ref: Optional[Callable] = None  # (*np.ndarray) -> np.ndarray
+    domain: Tuple[float, float] = (-1.0, 1.0)
+    grad: bool = True  # finite-difference check
+    bf16: bool = True  # bf16-vs-f32 consistency
+    int_inputs: Sequence[int] = ()  # indices of inputs that are integer
+    rtol: Optional[float] = None
+    atol: Optional[float] = None
+    separated: bool = False  # well-separated values (max/min FD stability)
+
+
+def _inputs_np(case: Case, rng: np.random.RandomState):
+    lo, hi = case.domain
+    out = []
+    for i, s in enumerate(case.shapes):
+        if i in case.int_inputs:
+            out.append(rng.randint(0, 3, size=s).astype(np.float32))
+        elif case.separated:
+            # distinct values spaced >> 2*eps so the FD probes can't flip
+            # an argmax/argmin tie
+            n = int(np.prod(s))
+            vals = lo + (hi - lo) * (rng.permutation(n) + 0.5) / n
+            out.append(vals.reshape(s).astype(np.float32))
+        else:
+            out.append(rng.uniform(lo, hi, size=s).astype(np.float32))
+    return out
+
+
+def _sum_all(x):
+    if isinstance(x, (list, tuple)):
+        return sum(o.sum() for o in x)
+    return x.sum()
+
+
+# ---------------------------------------------------------------------------
+# unary math: (mx name, numpy ref, domain, differentiable)
+# ---------------------------------------------------------------------------
+_UNARY = [
+    ("abs", np.abs, (0.2, 1.0), True),
+    ("arccos", np.arccos, (-0.8, 0.8), True),
+    ("arccosh", np.arccosh, (1.2, 2.5), True),
+    ("arcsin", np.arcsin, (-0.8, 0.8), True),
+    ("arcsinh", np.arcsinh, (-1.0, 1.0), True),
+    ("arctan", np.arctan, (-1.0, 1.0), True),
+    ("arctanh", np.arctanh, (-0.8, 0.8), True),
+    ("cbrt", np.cbrt, (0.2, 2.0), True),
+    ("ceil", np.ceil, (-2.0, 2.0), False),
+    ("cos", np.cos, (-1.0, 1.0), True),
+    ("cosh", np.cosh, (-1.0, 1.0), True),
+    ("degrees", np.degrees, (-1.0, 1.0), True),
+    ("erf", None, (-1.0, 1.0), True),
+    ("exp", np.exp, (-1.0, 1.0), True),
+    ("expm1", np.expm1, (-1.0, 1.0), True),
+    ("fix", np.trunc, (-2.0, 2.0), False),
+    ("floor", np.floor, (-2.0, 2.0), False),
+    ("gamma", None, (0.5, 2.5), True),
+    ("gammaln", None, (0.5, 2.5), True),
+    ("log", np.log, (0.2, 2.5), True),
+    ("log10", np.log10, (0.2, 2.5), True),
+    ("log1p", np.log1p, (-0.5, 1.0), True),
+    ("log2", np.log2, (0.2, 2.5), True),
+    ("negative", np.negative, (-1.0, 1.0), True),
+    ("radians", np.radians, (-1.0, 1.0), True),
+    ("rcbrt", lambda x: 1 / np.cbrt(x), (0.3, 2.0), True),
+    ("reciprocal", lambda x: 1 / x, (0.4, 2.0), True),
+    ("relu", lambda x: np.maximum(x, 0), (-1.0, 1.0), True),
+    ("rint", np.rint, (-2.0, 2.0), False),
+    ("round", None, (-2.0, 2.0), False),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.3, 2.0), True),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-1.0, 1.0), True),
+    ("sign", np.sign, (0.2, 1.0), False),
+    ("sin", np.sin, (-1.0, 1.0), True),
+    ("sinh", np.sinh, (-1.0, 1.0), True),
+    ("softsign", lambda x: x / (1 + np.abs(x)), (-1.0, 1.0), True),
+    ("sqrt", np.sqrt, (0.2, 2.0), True),
+    ("square", np.square, (-1.0, 1.0), True),
+    ("tan", np.tan, (-1.0, 1.0), True),
+    ("tanh", np.tanh, (-1.0, 1.0), True),
+    ("trunc", np.trunc, (-2.0, 2.0), False),
+]
+
+# binary broadcast ops
+_BINARY = [
+    ("broadcast_add", np.add, (-1.0, 1.0), True),
+    ("broadcast_sub", np.subtract, (-1.0, 1.0), True),
+    ("broadcast_mul", np.multiply, (-1.0, 1.0), True),
+    ("broadcast_div", np.divide, (0.4, 2.0), True),
+    ("broadcast_maximum", np.maximum, (-1.0, 1.0), True),
+    ("broadcast_minimum", np.minimum, (-1.0, 1.0), True),
+    ("broadcast_power", np.power, (0.4, 2.0), True),
+    ("broadcast_hypot", np.hypot, (0.2, 1.0), True),
+    ("elemwise_add", np.add, (-1.0, 1.0), True),
+    ("elemwise_sub", np.subtract, (-1.0, 1.0), True),
+    ("elemwise_mul", np.multiply, (-1.0, 1.0), True),
+    ("elemwise_div", np.divide, (0.4, 2.0), True),
+]
+
+# scalar-arg ops: forward refs
+_SCALAR = [
+    ("_plus_scalar", lambda x: x + 0.5, True),
+    ("_minus_scalar", lambda x: x - 0.5, True),
+    ("_rminus_scalar", lambda x: 0.5 - x, True),
+    ("_mul_scalar", lambda x: x * 0.5, True),
+    ("_div_scalar", lambda x: x / 0.5, True),
+    ("_rdiv_scalar", lambda x: 0.5 / x, True),
+    ("_power_scalar", lambda x: x**2.0, True),
+    ("_maximum_scalar", lambda x: np.maximum(x, 0.1), True),
+    ("_minimum_scalar", lambda x: np.minimum(x, 0.1), True),
+]
+
+
+def _build_cases():
+    cases = []
+    for name, ref, domain, diff in _UNARY:
+        op = getattr(nd, name)
+        cases.append(Case(id=f"unary_{name}", fn=op, shapes=[(2, 5)], ref=ref,
+                          domain=domain, grad=diff))
+    for name, ref, domain, diff in _BINARY:
+        op = getattr(nd, name)
+        shapes = ([(2, 3, 2), (2, 3, 2)] if name.startswith("elemwise")
+                  else [(2, 3, 2), (1, 3, 1)])
+        cases.append(Case(id=f"binary_{name}", fn=op, shapes=shapes, ref=ref,
+                          domain=domain, grad=diff))
+    for name, ref, diff in _SCALAR:
+        op = getattr(nd, name)
+        scalar = 2.0 if "power" in name else 0.5
+        if "maximum" in name or "minimum" in name:
+            scalar = 0.1
+        fn = (lambda op, s: lambda x: op(x, scalar=s))(op, scalar)
+        cases.append(Case(id=f"scalar_{name}", fn=fn, shapes=[(2, 5)], ref=ref,
+                          domain=(0.3, 1.0), grad=diff))
+
+    # reductions
+    for name, ref in [("sum", np.sum), ("mean", np.mean), ("prod", np.prod),
+                      ("max", np.max), ("min", np.min)]:
+        op = getattr(nd, name)
+        sep = name in ("max", "min")
+        cases.append(Case(id=f"reduce_{name}_all", fn=op, shapes=[(2, 3, 2)],
+                          ref=ref, domain=(0.3, 1.0), separated=sep))
+        cases.append(Case(
+            id=f"reduce_{name}_ax1",
+            fn=(lambda op: lambda x: op(x, axis=1))(op),
+            shapes=[(2, 3, 2)],
+            ref=(lambda ref: lambda x: ref(x, axis=1))(ref),
+            domain=(0.3, 1.0), separated=sep))
+    cases.append(Case(id="reduce_norm",
+                      fn=lambda x: nd.norm(x),
+                      shapes=[(2, 5)],
+                      ref=lambda x: np.linalg.norm(x).reshape(1),
+                      domain=(0.3, 1.0)))
+    cases.append(Case(id="reduce_nansum", fn=lambda x: nd.nansum(x),
+                      shapes=[(2, 5)], ref=np.sum, domain=(0.3, 1.0),
+                      grad=False))
+
+    # matrix / shape ops
+    cases += [
+        Case(id="dot", fn=nd.dot, shapes=[(3, 4), (4, 2)],
+             ref=lambda a, b: a @ b),
+        Case(id="batch_dot", fn=nd.batch_dot, shapes=[(2, 3, 4), (2, 4, 2)],
+             ref=lambda a, b: a @ b),
+        Case(id="transpose", fn=lambda x: nd.transpose(x, axes=(1, 0)),
+             shapes=[(3, 4)], ref=np.transpose),
+        Case(id="swapaxes", fn=lambda x: nd.swapaxes(x, dim1=0, dim2=2),
+             shapes=[(2, 3, 2)], ref=lambda x: np.swapaxes(x, 0, 2)),
+        Case(id="reshape", fn=lambda x: nd.reshape(x, shape=(4, 3)),
+             shapes=[(3, 4)], ref=lambda x: x.reshape(4, 3)),
+        Case(id="expand_dims", fn=lambda x: nd.expand_dims(x, axis=1),
+             shapes=[(3, 4)], ref=lambda x: x[:, None, :]),
+        Case(id="squeeze", fn=lambda x: nd.squeeze(x),
+             shapes=[(3, 1, 4)], ref=np.squeeze),
+        Case(id="flip", fn=lambda x: nd.flip(x, axis=1),
+             shapes=[(3, 4)], ref=lambda x: np.flip(x, 1)),
+        Case(id="tile", fn=lambda x: nd.tile(x, reps=(2, 2)),
+             shapes=[(2, 3)], ref=lambda x: np.tile(x, (2, 2))),
+        Case(id="repeat", fn=lambda x: nd.repeat(x, repeats=2, axis=1),
+             shapes=[(2, 3)], ref=lambda x: np.repeat(x, 2, 1)),
+        Case(id="slice", fn=lambda x: nd.slice(x, begin=(0, 1), end=(2, 3)),
+             shapes=[(3, 4)], ref=lambda x: x[0:2, 1:3]),
+        Case(id="slice_axis",
+             fn=lambda x: nd.slice_axis(x, axis=1, begin=1, end=3),
+             shapes=[(3, 4)], ref=lambda x: x[:, 1:3]),
+        Case(id="clip", fn=lambda x: nd.clip(x, a_min=-0.5, a_max=0.5),
+             shapes=[(3, 4)], ref=lambda x: np.clip(x, -0.5, 0.5)),
+        Case(id="concat", fn=lambda a, b: nd.concat(a, b, dim=1),
+             shapes=[(2, 3), (2, 2)],
+             ref=lambda a, b: np.concatenate([a, b], axis=1)),
+        Case(id="stack", fn=lambda a, b: nd.stack(a, b, axis=0),
+             shapes=[(2, 3), (2, 3)], ref=lambda a, b: np.stack([a, b])),
+        Case(id="split",
+             fn=lambda x: nd.split(x, num_outputs=2, axis=1),
+             shapes=[(2, 4)], grad=True,
+             ref=None),
+        Case(id="where", fn=lambda c, a, b: nd.where(c, a, b),
+             shapes=[(2, 3), (2, 3), (2, 3)], int_inputs=[0],
+             ref=lambda c, a, b: np.where(c != 0, a, b), grad=False),
+        Case(id="take", fn=lambda w, i: nd.take(w, i),
+             shapes=[(4, 3), (2, 2)], int_inputs=[1],
+             ref=lambda w, i: w[i.astype(int)], grad=False),
+        Case(id="one_hot", fn=lambda i: nd.one_hot(i, depth=4),
+             shapes=[(5,)], int_inputs=[0],
+             ref=lambda i: np.eye(4, dtype=np.float32)[i.astype(int)],
+             grad=False),
+        Case(id="pick", fn=lambda x, i: nd.pick(x, i, axis=1),
+             shapes=[(3, 4), (3,)], int_inputs=[1],
+             ref=lambda x, i: x[np.arange(3), i.astype(int)], grad=False),
+        Case(id="gather_nd",
+             fn=lambda x: nd.gather_nd(x, nd.array(np.array([[0, 1], [1, 0]]).T)),
+             shapes=[(2, 3)],
+             ref=lambda x: np.stack([x[0, 1], x[1, 0]]), grad=False),
+        Case(id="pad",
+             fn=lambda x: nd.pad(x, mode="constant",
+                                 pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+             shapes=[(1, 1, 2, 3)],
+             ref=lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1))),
+             grad=True),
+        Case(id="diag", fn=lambda x: nd.diag(x), shapes=[(3, 3)],
+             ref=np.diag, grad=False),
+        Case(id="depth_to_space", fn=lambda x: nd.depth_to_space(x, block_size=2),
+             shapes=[(1, 4, 2, 2)], grad=True),
+        Case(id="space_to_depth", fn=lambda x: nd.space_to_depth(x, block_size=2),
+             shapes=[(1, 1, 4, 4)], grad=True),
+        Case(id="smooth_l1", fn=lambda x: nd.smooth_l1(x, scalar=1.0),
+             shapes=[(2, 5)], domain=(-2.0, 2.0), grad=True),
+        Case(id="softmax", fn=lambda x: nd.softmax(x, axis=-1),
+             shapes=[(3, 4)],
+             ref=lambda x: (np.exp(x - x.max(-1, keepdims=True))
+                            / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))),
+        Case(id="log_softmax", fn=lambda x: nd.log_softmax(x, axis=-1),
+             shapes=[(3, 4)],
+             ref=lambda x: x - x.max(-1, keepdims=True)
+             - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))),
+    ]
+
+    # NN layer ops
+    cases += [
+        Case(id="FullyConnected",
+             fn=lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3),
+             shapes=[(2, 4), (3, 4), (3,)],
+             ref=lambda x, w, b: x @ w.T + b),
+        Case(id="FullyConnected_nobias",
+             fn=lambda x, w: nd.FullyConnected(x, w, num_hidden=3,
+                                               no_bias=True),
+             shapes=[(2, 4), (3, 4)], ref=lambda x, w: x @ w.T),
+        Case(id="Convolution_1x1",
+             fn=lambda x, w: nd.Convolution(x, w, kernel=(1, 1), num_filter=2,
+                                            no_bias=True),
+             shapes=[(1, 3, 4, 4), (2, 3, 1, 1)],
+             ref=lambda x, w: np.einsum("bchw,fcij->bfhw", x, w)),
+        Case(id="Convolution_3x3",
+             fn=lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
+                                            pad=(1, 1), no_bias=True),
+             shapes=[(1, 2, 4, 4), (2, 2, 3, 3)]),
+        Case(id="Deconvolution",
+             fn=lambda x, w: nd.Deconvolution(x, w, kernel=(2, 2), stride=(2, 2),
+                                              num_filter=2, no_bias=True),
+             shapes=[(1, 2, 3, 3), (2, 2, 2, 2)]),
+        Case(id="Pooling_max",
+             fn=lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                     pool_type="max"),
+             shapes=[(1, 2, 4, 4)], separated=True),
+        Case(id="Pooling_avg",
+             fn=lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                     pool_type="avg"),
+             shapes=[(1, 2, 4, 4)]),
+        Case(id="Pooling_global",
+             fn=lambda x: nd.Pooling(x, global_pool=True, pool_type="avg"),
+             shapes=[(1, 2, 4, 4)],
+             ref=lambda x: x.mean(axis=(2, 3), keepdims=True)),
+        Case(id="LayerNorm",
+             fn=lambda x, g, b: nd.LayerNorm(x, g, b),
+             shapes=[(3, 6), (6,), (6,)]),
+        Case(id="BatchNorm_infer",
+             fn=lambda x, g, b, m, v: nd.BatchNorm(
+                 x, g, b, m, v, fix_gamma=False, use_global_stats=True),
+             shapes=[(2, 3, 2, 2), (3,), (3,), (3,), (3,)],
+             domain=(0.3, 1.0), grad=False),
+        Case(id="L2Normalization",
+             fn=lambda x: nd.L2Normalization(x),
+             shapes=[(2, 6)],
+             ref=lambda x: x / np.sqrt((x**2).sum(1, keepdims=True) + 1e-10)),
+        Case(id="Activation_tanh",
+             fn=lambda x: nd.Activation(x, act_type="tanh"),
+             shapes=[(2, 5)], ref=np.tanh),
+        Case(id="LeakyReLU",
+             fn=lambda x: nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+             shapes=[(2, 5)], domain=(-1.0, 1.0),
+             ref=lambda x: np.where(x > 0, x, 0.1 * x)),
+        Case(id="Embedding",
+             fn=lambda i, w: nd.Embedding(i, w, input_dim=4, output_dim=3),
+             shapes=[(2, 2), (4, 3)], int_inputs=[0], grad=False,
+             ref=lambda i, w: w[i.astype(int)]),
+        Case(id="softmax_cross_entropy",
+             fn=lambda x, lab: nd.softmax_cross_entropy(x, lab),
+             shapes=[(3, 4), (3,)], int_inputs=[1], grad=False),
+    ]
+    return cases
+
+
+CASES = _build_cases()
+_IDS = [c.id for c in CASES]
+
+
+@pytest.fixture(autouse=True)
+def _rng():
+    np.random.seed(7)
+    yield
+
+
+@pytest.mark.parametrize("case", CASES, ids=_IDS)
+def test_forward(case):
+    rng = np.random.RandomState(11)
+    arrs = _inputs_np(case, rng)
+    out = case.fn(*[nd.array(a) for a in arrs])
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        v = o.asnumpy()
+        assert np.isfinite(v.astype(np.float64)).all(), case.id
+    if case.ref is not None:
+        expect = case.ref(*arrs)
+        np.testing.assert_allclose(
+            outs[0].asnumpy().astype(np.float64),
+            np.asarray(expect).astype(np.float64),
+            rtol=case.rtol or 1e-4, atol=case.atol or 1e-5,
+            err_msg=f"forward mismatch: {case.id}")
+
+
+@pytest.mark.parametrize("case", [c for c in CASES if c.grad],
+                         ids=[c.id for c in CASES if c.grad])
+def test_gradient(case):
+    """Analytic (tape) gradient vs central finite differences through a
+    fixed random projection (reference: check_numeric_gradient)."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rng = np.random.RandomState(13)
+    arrs = _inputs_np(case, rng)
+    inputs = [nd.array(a) for a in arrs]
+    # fixed projection so e.g. softmax's row-sum==1 structure stays visible
+    probe = {}
+
+    def loss_fn(*xs):
+        out = case.fn(*xs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        total = None
+        for k, o in enumerate(outs):
+            if k not in probe:
+                probe[k] = nd.array(
+                    np.random.RandomState(17 + k).uniform(0.5, 1.5, o.shape)
+                    .astype(np.float32))
+            term = (o * probe[k]).sum()
+            total = term if total is None else total + term
+        return total
+
+    diff_idx = [i for i in range(len(inputs)) if i not in case.int_inputs]
+    check_numeric_gradient(loss_fn, [inputs[i] for i in diff_idx]
+                           if len(diff_idx) == len(inputs) else inputs,
+                           eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+@pytest.mark.parametrize("case", [c for c in CASES if c.bf16],
+                         ids=[c.id for c in CASES if c.bf16])
+def test_bf16_consistency(case):
+    """f32-vs-bf16 sweep (reference: check_consistency dtype axis)."""
+    rng = np.random.RandomState(19)
+    arrs = _inputs_np(case, rng)
+
+    def run(dtype):
+        ins = []
+        for i, a in enumerate(arrs):
+            if i in case.int_inputs:
+                ins.append(nd.array(a))
+            else:
+                ins.append(nd.array(a.astype(dtype), dtype=dtype))
+        out = case.fn(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.asnumpy().astype(np.float64) for o in outs]
+
+    f32 = run(np.float32)
+    b16 = run(BF16)
+    for a, b in zip(f32, b16):
+        scale = max(1.0, float(np.abs(a).max()))
+        np.testing.assert_allclose(
+            a, b, rtol=0.1, atol=0.05 * scale,
+            err_msg=f"bf16 inconsistent with f32: {case.id}")
+
+
+@pytest.mark.parametrize("case",
+                         [c for c in CASES if c.grad and c.bf16],
+                         ids=[c.id for c in CASES if c.grad and c.bf16])
+def test_bf16_backward_finite(case):
+    """Backward runs and is finite in bf16 (crash-class regression net)."""
+    rng = np.random.RandomState(23)
+    arrs = _inputs_np(case, rng)
+    inputs = []
+    for i, a in enumerate(arrs):
+        if i in case.int_inputs:
+            inputs.append(nd.array(a))
+        else:
+            inputs.append(nd.array(a.astype(BF16), dtype=BF16))
+    for i, x in enumerate(inputs):
+        if i not in case.int_inputs:
+            x.attach_grad()
+    with autograd.record():
+        loss = _sum_all(case.fn(*inputs))
+    loss.backward()
+    for i, x in enumerate(inputs):
+        if i not in case.int_inputs and x.grad is not None:
+            g = x.grad.asnumpy().astype(np.float64)
+            assert np.isfinite(g).all(), case.id
