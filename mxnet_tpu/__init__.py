@@ -29,6 +29,7 @@ from . import optimizer
 from .optimizer import lr_scheduler
 from . import metric
 from . import kvstore
+from . import kvstore as kv  # reference alias: mx.kv.create(...)
 from .kvstore import KVStore
 from . import recordio
 from . import symbol
@@ -50,6 +51,12 @@ from .util import is_np_array
 from . import test_utils
 from . import contrib
 from . import models
+
+# Multi-process rendezvous must run BEFORE any computation initializes the
+# jax backends, so when the launcher env (tools/launch.py: MX_COORDINATOR /
+# DMLC_PS_ROOT_URI) is present, connect at import time (reference analog:
+# ps::Postoffice::Start, which launch.py's env likewise triggers).
+parallel.dist.init_from_env()
 
 
 def waitall():

@@ -78,12 +78,16 @@ class Context:
         """Resolve to a concrete jax.Device (lazy; raises if absent)."""
         jax = _jax()
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            # LOCAL devices: under multi-process SPMD, jax.devices() lists
+            # every host's devices; a context must resolve to one this
+            # process can address (reference semantics: each worker sees
+            # only its own devices).
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
                 # Platform list restricted (e.g. JAX_PLATFORMS=axon): fall back
                 # to the default backend so cpu-context code still runs.
-                devs = jax.devices()
+                devs = jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         # accelerator: gpu is an alias for whatever accelerator jax exposes
         devs = _accel_devices()
@@ -116,7 +120,7 @@ class Context:
 
 def _accel_devices() -> List:
     jax = _jax()
-    devs = jax.devices()
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform not in ("cpu",)]
     if accel:
         return accel
@@ -144,7 +148,7 @@ def tpu(device_id: int = 0) -> Context:
 def num_gpus() -> int:
     """Number of accelerator devices (gpu alias — see module docstring)."""
     try:
-        return len([d for d in _jax().devices() if d.platform != "cpu"])
+        return len([d for d in _jax().local_devices() if d.platform != "cpu"])
     except RuntimeError:
         return 0
 

@@ -41,6 +41,13 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        if kv_type.startswith("dist"):
+            # rendezvous with the coordination service when launched by
+            # tools/launch.py (reference: ps::Postoffice::Start on first
+            # KVStoreDist construction)
+            from .parallel import dist
+
+            dist.init_from_env()
 
     # ------------------------------------------------------------------
     @property
@@ -50,31 +57,34 @@ class KVStore:
     @property
     def rank(self) -> int:
         if self._type.startswith("dist"):
-            import jax
+            from .parallel import dist
 
-            try:
-                return jax.process_index()
-            except Exception:
-                return 0
+            return dist.process_index()
         return 0
 
     @property
     def num_workers(self) -> int:
         if self._type.startswith("dist"):
-            import jax
+            from .parallel import dist
 
-            try:
-                return jax.process_count()
-            except Exception:
-                return 1
+            return dist.process_count()
         return 1
 
     # ------------------------------------------------------------------
     def init(self, key, value) -> None:
         keys, values = self._key_value(key, value)
+        dist_bcast = self._type.startswith("dist") and self.num_workers > 1
         for k, v in zip(keys, values):
             vals = _as_list(v)
-            self._store[k] = vals[0].copy()
+            init_val = vals[0].copy()
+            if dist_bcast:
+                # reference contract (KVStoreDist): only rank 0's init value
+                # reaches the store; every worker starts from the SAME
+                # parameters.  Broadcast = allreduce of (rank0 ? v : 0).
+                if self.rank != 0:
+                    init_val = init_val * 0
+                init_val = self._global_sum(init_val)
+            self._store[k] = init_val
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = self._key_value(key, value)

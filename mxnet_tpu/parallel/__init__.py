@@ -10,4 +10,5 @@ from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
                    global_allreduce)
 from .data_parallel import DataParallelStep, make_train_step
 from .ring import ring_attention, ring_self_attention
+from . import dist
 from . import sharding

@@ -1,0 +1,167 @@
+"""Multi-process (multi-host) distributed backend.
+
+Reference parity: ps-lite's scheduler rendezvous + ZMQ data plane
+(3rdparty/ps-lite, src/kvstore/kvstore_dist.h worker side,
+kvstore_dist_server.h server side) and tools/launch.py's DMLC_* env
+contract.  TPU-native design (SURVEY §2.4, §5.8): the rendezvous is
+jax.distributed.initialize (coordination service), and the data plane is a
+COMPILED XLA collective over the global device mesh — gradients are summed
+by `psum` riding DCN (Gloo on CPU hosts, ICI/DCN on pods), never staged
+through host memory the way a parameter server would.
+
+Environment contract (reference tools/launch.py exports DMLC_*; both
+spellings are honored so reference launch scripts work unchanged):
+
+  MX_COORDINATOR      / DMLC_PS_ROOT_URI + DMLC_PS_ROOT_PORT
+  MX_NUM_PROCS        / DMLC_NUM_WORKER
+  MX_PROC_ID          / DMLC_WORKER_ID
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["init_from_env", "is_initialized", "allreduce_sum",
+           "process_index", "process_count"]
+
+_initialized = False
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def _jax_distributed_active() -> bool:
+    """True when jax.distributed.initialize already ran (by us or by the
+    user's own pod-startup code)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client is not None
+    except Exception:
+        return False
+
+
+def init_from_env(force_cpu: Optional[bool] = None) -> bool:
+    """Connect this process to the coordination service if the launcher env
+    is present (reference: ps::Postoffice::Start reading DMLC_ROLE etc.).
+
+    Returns True when running multi-process after the call.  Idempotent,
+    and treats a distributed runtime that the USER already initialized
+    (conventional on pod startup) as success.
+
+    jax requires this to run before any computation initializes the
+    backends — mxnet_tpu/__init__ therefore calls this at import time when
+    the launcher env is present; the KVStore constructor is only a
+    fallback for exotic import orders.
+    """
+    global _initialized
+    import jax
+
+    if _initialized or _jax_distributed_active():
+        _initialized = True
+        return jax.process_count() > 1
+    coord = _env("MX_COORDINATOR")
+    if coord is None:
+        uri = _env("DMLC_PS_ROOT_URI")
+        port = _env("DMLC_PS_ROOT_PORT")
+        coord = f"{uri}:{port}" if uri and port else None
+    n = _env("MX_NUM_PROCS", "DMLC_NUM_WORKER")
+    rank = _env("MX_PROC_ID", "DMLC_WORKER_ID")
+    if coord is None or n is None or rank is None:
+        return False  # single-process
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        raise MXNetError(
+            "the distributed launcher env (MX_COORDINATOR/MX_NUM_PROCS) is "
+            "set, but jax backends were already initialized before the "
+            "rendezvous could run.  Import mxnet_tpu (or create the dist "
+            "kvstore) BEFORE running any computation, or call "
+            "jax.distributed.initialize() yourself at program start.")
+    if force_cpu or (force_cpu is None and _env("MX_FORCE_CPU") == "1"):
+        jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(n), process_id=int(rank))
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def process_count() -> int:
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# compiled global allreduce
+# ---------------------------------------------------------------------------
+# (mesh, my lead device, jitted reducer) — built once; jax.jit's own cache
+# handles per-shape/dtype specialization
+_allreduce_state = None
+
+
+def _get_allreduce_state():
+    global _allreduce_state
+    if _allreduce_state is None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        by_proc: Dict[int, object] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        leads = [by_proc[i] for i in sorted(by_proc)]
+        mesh = Mesh(np.array(leads), ("hosts",))
+        reducer = jax.jit(lambda a: a.sum(axis=0),
+                          out_shardings=NamedSharding(mesh, P()))
+        _allreduce_state = (mesh, leads[process_index()], reducer)
+    return _allreduce_state
+
+
+def allreduce_sum(arr):
+    """Sum a per-process jax/numpy array across all processes; returns the
+    (replicated) result as a jax array on this process's lead device.
+
+    Compiled path: the per-host contributions form ONE global array sharded
+    over the 'hosts' mesh axis; a jitted sum over that axis lowers to an
+    XLA all-reduce on the wire (reference equivalent being replaced:
+    kvstore_dist_server.h DataHandleEx server-side aggregation ~L200).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = process_count()
+    if n == 1:
+        return jax.numpy.asarray(arr)
+    mesh, lead, reducer = _get_allreduce_state()
+    local = jax.numpy.asarray(arr)
+    garr = jax.make_array_from_single_device_arrays(
+        (n,) + tuple(local.shape),
+        NamedSharding(mesh, P("hosts")),
+        [jax.device_put(local[None], lead)])
+    out = reducer(garr)
+    return out.addressable_shards[0].data

@@ -84,14 +84,15 @@ def host_barrier() -> None:
 
 
 def global_allreduce(nd):
-    """Sum an NDArray across all hosts (DCN allreduce for dist_sync kvstore)."""
+    """Sum an NDArray across all hosts — a COMPILED XLA collective over the
+    host mesh (DCN/Gloo on the wire), not a host-memory allgather
+    (reference being replaced: kvstore_dist_server.h DataHandleEx)."""
     jax = _jax()
     if jax.process_count() == 1:
         return nd
-    from jax.experimental import multihost_utils
-
     from ..ndarray import NDArray
+    from .dist import allreduce_sum
 
-    summed = multihost_utils.process_allgather(nd._data).sum(axis=0)
+    summed = allreduce_sum(nd._data)
     return NDArray(jax.device_put(summed, nd.context.jax_device),
                    ctx=nd.context)

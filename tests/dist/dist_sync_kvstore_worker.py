@@ -1,0 +1,62 @@
+"""Worker body for the 2-process dist_sync kvstore test (reference:
+tests/nightly/dist_sync_kvstore.py, launched by tools/launch.py local mode).
+
+Each worker pushes known tensors; the pulled value must equal the analytic
+expectation.  Run via:
+
+    python tools/launch.py -n 2 --force-cpu python tests/dist/dist_sync_kvstore_worker.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    n = kv.num_workers
+    rank = kv.rank
+    assert n == int(os.environ["MX_NUM_PROCS"]), (n, os.environ["MX_NUM_PROCS"])
+    shape = (4, 3)
+
+    # --- plain aggregation: store ends at the global sum of pushes -------
+    kv.init("a", nd.zeros(shape))
+    kv.push("a", nd.ones(shape) * (rank + 1))
+    out = nd.zeros(shape)
+    kv.pull("a", out=out)
+    expect = sum(r + 1 for r in range(n))  # 3 for n=2
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
+                               rtol=1e-6)
+
+    # --- init broadcast: only rank 0's init value reaches the store ------
+    kv.init("b", nd.ones(shape) * (rank + 7))
+    outb = nd.zeros(shape)
+    kv.pull("b", out=outb)
+    np.testing.assert_allclose(outb.asnumpy(), np.full(shape, 7.0),
+                               rtol=1e-6,
+                               err_msg="init must broadcast rank 0's value")
+
+    # --- server-side optimizer semantics (update_on_kvstore) -------------
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv2.init(3, nd.ones(shape))
+    for step in range(4):
+        kv2.push(3, nd.ones(shape) * (rank + 1))
+    w = nd.zeros(shape)
+    kv2.pull(3, out=w)
+    # each push applies w -= lr * global_grad_sum; grad_sum = 3 per step
+    expect_w = 1.0 - 0.1 * expect * 4
+    np.testing.assert_allclose(w.asnumpy(), np.full(shape, expect_w),
+                               rtol=1e-5)
+
+    kv.barrier()
+    print(f"worker {rank}/{n}: dist_sync kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Multi-process-on-one-host distributed tests (SURVEY §4.4 item 4 —
+reference: CI runs tools/launch.py -n 3 --launcher local
+tests/nightly/dist_sync_kvstore.py).
+
+These spawn REAL worker processes via tools/launch.py local mode; inside,
+gradients cross process boundaries through the compiled Gloo/DCN allreduce
+in parallel/dist.py.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, script, timeout=240):
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", str(n), "--force-cpu", "--",
+           sys.executable, os.path.join(_REPO, script)]
+    return subprocess.run(cmd, cwd=_REPO, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_dist_sync_kvstore_two_workers():
+    res = _launch(2, "tests/dist/dist_sync_kvstore_worker.py")
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("dist_sync kvstore OK") == 2, res.stdout
+
+
+def test_dist_sync_training_two_workers():
+    res = _launch(2, "tests/dist/dist_train_worker.py")
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("dist train OK") == 2, res.stdout
+
+
+def test_launch_cli_rejects_missing_command():
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"), "-n", "2"],
+        capture_output=True, text=True)
+    assert res.returncode != 0

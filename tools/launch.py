@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py ~L1-200 +
+3rdparty/dmlc-core/tracker/dmlc_tracker — scheduler/server/worker spawn with
+DMLC_* env).
+
+TPU-native redesign: there is no parameter-server role — every process is a
+worker; rendezvous is jax.distributed's coordination service (worker 0 hosts
+it) and aggregation is compiled XLA collectives (mxnet_tpu/parallel/dist.py).
+The reference CLI is kept so launch scripts port unchanged:
+
+    python tools/launch.py -n 4 --launcher local python train.py --kv-store dist_sync
+
+Launchers:
+  local  N worker processes on this host (the reference's dmlc_tracker
+         'local' mode, used by its nightly dist tests) — implemented.
+  ssh/mpi/yarn/sge  cluster bring-up: out of scope here; on GKE/Cloud the
+         per-host env is provided by the pod spec (MX_COORDINATOR etc.),
+         so no tracker is needed (SURVEY §2.4 launcher row).
+
+Both MX_* and DMLC_* env spellings are exported to workers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers: int, command, env_extra=None,
+                 force_cpu: bool = False) -> int:
+    """Spawn num_workers processes of `command` on this host; returns the
+    first non-zero exit code (killing the rest), else 0."""
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "MX_COORDINATOR": f"127.0.0.1:{port}",
+            "MX_NUM_PROCS": str(num_workers),
+            "MX_PROC_ID": str(rank),
+            # reference spellings (kvstore rank/num_workers, user scripts)
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if force_cpu:
+            env["MX_FORCE_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            # drop the axon sitecustomize so worker processes don't dial
+            # the TPU relay at interpreter boot
+            pp = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in pp.split(os.pathsep) if "axon" not in p)
+        procs.append(subprocess.Popen(command, env=env))
+
+    rc = 0
+    try:
+        for p in procs:
+            r = p.wait()
+            if r != 0 and rc == 0:
+                rc = r
+                for q in procs:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        rc = 130
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job.")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference CLI compat; ignored "
+                         "(no parameter-server role in the SPMD design)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "mpi", "sge", "yarn"])
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="pin workers to the CPU backend (testing)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run on every worker")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    command = args.command[1:] if args.command[0] == "--" else args.command
+    if args.launcher != "local":
+        ap.error(f"launcher {args.launcher!r} is cluster bring-up; supply "
+                 "MX_COORDINATOR/MX_NUM_PROCS/MX_PROC_ID via your scheduler "
+                 "(pod spec) instead — see module docstring")
+    if args.num_servers:
+        print("launch.py: -s/--num-servers ignored (no PS role on TPU)",
+              file=sys.stderr)
+    return launch_local(args.num_workers, command, force_cpu=args.force_cpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
