@@ -50,6 +50,41 @@ class _TapeNode:
         self.output_ids = [id(o) for o in outputs]
 
 
+class _RowSparseCT:
+    """A row-sparse cotangent: rows `indices` of a (vocab, dim) gradient
+    hold `values`; all other rows are zero.  Produced by ops recorded with
+    sparse_grad=True (Embedding) so huge vocab gradients are never
+    materialized densely on the tape (reference: row_sparse gradients,
+    src/operator/tensor/indexing_op.h EmbeddingOpBackward)."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices  # (nnz,) int
+        self.values = values    # (nnz, *row_shape)
+        self.shape = tuple(shape)
+
+    def concat(self, other: "_RowSparseCT") -> "_RowSparseCT":
+        import jax.numpy as jnp
+
+        return _RowSparseCT(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+    def densify(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def aggregated(self):
+        """(unique_sorted_indices, summed_values) — true dynamic row count
+        via the shared eager aggregation (sparse.aggregate_rows)."""
+        from .ndarray.sparse import aggregate_rows
+
+        return aggregate_rows(self.indices, self.values)
+
+
 class _State(threading.local):
     def __init__(self):
         self.recording = False
@@ -187,16 +222,28 @@ def _walk_tape(head_pairs, retain_graph=False):
             g = grads.get(oid)
             if g is None:
                 g = jnp.zeros_like(out)
+            elif isinstance(g, _RowSparseCT):
+                # propagating a sparse cotangent THROUGH another op's vjp
+                # needs the dense form (rare: the sparse-grad producer's
+                # input is normally a leaf parameter)
+                g = g.densify()
             cts.append(g)
         in_grads = node.vjp_fn(tuple(cts) if len(cts) > 1 else cts[0])
         for arr, aid, g in zip(node.input_arrays, node.input_ids, in_grads):
             if g is None or _is_float0(g):
                 continue
-            if aid in grads:
-                grads[aid] = grads[aid] + g
-            else:
+            prev = grads.get(aid)
+            if prev is None:
                 grads[aid] = g
                 keep[aid] = arr
+            elif isinstance(prev, _RowSparseCT) and isinstance(g, _RowSparseCT):
+                grads[aid] = prev.concat(g)
+            elif isinstance(prev, _RowSparseCT):
+                grads[aid] = prev.densify() + g
+            elif isinstance(g, _RowSparseCT):
+                grads[aid] = prev + g.densify()
+            else:
+                grads[aid] = prev + g
     if not retain_graph:
         _state.tape = []
     return grads
@@ -234,10 +281,33 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True) -> Non
         g = grads.get(aid)
         if g is None:
             continue
+        from .ndarray.sparse import RowSparseNDArray
+
+        buf = nd._grad
+        if isinstance(g, _RowSparseCT) and isinstance(buf, RowSparseNDArray):
+            uids, vals = g.aggregated()
+            if nd._grad_req == "add" and buf._data.shape[0]:
+                merged = _RowSparseCT(
+                    jnp.concatenate([buf._aux["indices"], uids]),
+                    jnp.concatenate([buf._data,
+                                     vals.astype(buf._data.dtype)]), g.shape)
+                uids, vals = merged.aggregated()
+            buf._set_sparse_components(vals.astype(buf._data.dtype), uids)
+            continue
+        if isinstance(g, _RowSparseCT):
+            g = g.densify()
+        if isinstance(buf, RowSparseNDArray):
+            # dense cotangent into a sparse buffer: every row is touched
+            g = g.astype(buf._data.dtype)
+            if nd._grad_req == "add" and buf._data.shape[0]:
+                g = g + buf.todense()._data
+            idx = jnp.arange(g.shape[0])
+            buf._set_sparse_components(g, idx)
+            continue
         if nd._grad_req == "add":
-            nd._grad._set_data(nd._grad._data + g.astype(nd._grad._data.dtype))
+            buf._set_data(buf._data + g.astype(buf._data.dtype))
         else:
-            nd._grad._set_data(g.astype(nd._grad._data.dtype))
+            buf._set_data(g.astype(buf._data.dtype))
     if retain_graph:
         _state.leaves = leaves
 
@@ -281,7 +351,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             raise MXNetError(
                 "one of the variables is not part of the recorded graph"
             )
-        out.append(NDArray(g, ctx=v.context))
+        if isinstance(g, _RowSparseCT):
+            from .ndarray.sparse import RowSparseNDArray
+
+            uids, vals = g.aggregated()
+            out.append(RowSparseNDArray(vals, {"indices": uids}, g.shape,
+                                        ctx=v.context))
+        else:
+            out.append(NDArray(g, ctx=v.context))
     return out[0] if single else out
 
 

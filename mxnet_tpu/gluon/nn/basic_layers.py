@@ -218,20 +218,28 @@ class BatchNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Embedding lookup.  With sparse_grad=True the weight's gradient is a
+    RowSparseNDArray holding only the looked-up rows, and lazy-update
+    optimizers touch only those rows (reference: gluon/nn/basic_layers.py
+    Embedding(sparse_grad) + grad_stype='row_sparse' weights)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, prefix=None,
                  params=None):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer)
+                init=weight_initializer,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
 
     def __repr__(self):
         return f"Embedding({self._input_dim} -> {self._output_dim})"

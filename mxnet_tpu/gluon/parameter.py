@@ -90,6 +90,8 @@ class Parameter:
         self.wd_mult = wd_mult
         self.init = init
         self.allow_deferred_init = allow_deferred_init
+        self.stype = stype
+        self.grad_stype = grad_stype
         self._grad_req = grad_req if differentiable else "null"
         self._data: Optional[OrderedDict] = None  # ctx -> NDArray
         self._grad: Optional[OrderedDict] = None
@@ -159,11 +161,21 @@ class Parameter:
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
         from .. import autograd
 
         self._grad = OrderedDict()
         for ctx, data in self._data.items():
-            g = NDArray(jnp.zeros_like(data._data), ctx=ctx)
+            if self.grad_stype == "row_sparse":
+                # sparse grad buffer (reference: grad_stype='row_sparse'
+                # on sparse-grad Embedding weights); autograd writes
+                # (indices, values) into it without densifying
+                g = RowSparseNDArray(
+                    jnp.zeros((0,) + tuple(data.shape[1:]), data._data.dtype),
+                    {"indices": jnp.zeros((0,), jnp.int32)},
+                    tuple(data.shape), ctx=ctx)
+            else:
+                g = NDArray(jnp.zeros_like(data._data), ctx=ctx)
             self._grad[ctx] = g
             data._grad = g
             data._grad_req = self._grad_req
@@ -299,8 +311,13 @@ class Parameter:
             return
         import jax.numpy as jnp
 
+        from ..ndarray.sparse import RowSparseNDArray
+
         for g in self._grad.values():
-            g._set_data(jnp.zeros_like(g._data))
+            if isinstance(g, RowSparseNDArray):
+                g.zero()
+            else:
+                g._set_data(jnp.zeros_like(g._data))
 
     def reset_ctx(self, ctx) -> None:
         if isinstance(ctx, Context):

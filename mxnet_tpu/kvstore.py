@@ -174,7 +174,16 @@ class KVStore:
         return int(k) if isinstance(k, str) and k.isdigit() else k
 
     def _reduce(self, vals: List):
-        """Sum a per-device list on the lead device (CommDevice::Reduce)."""
+        """Sum a per-device list on the lead device (CommDevice::Reduce).
+
+        Sparse values densify first: per-worker nnz/rows differ, so the
+        collective needs the full logical shape (the reference's dist
+        row_sparse key encoding is a documented non-goal; dense aggregation
+        is correct, just not compact)."""
+        from .ndarray.sparse import BaseSparseNDArray
+
+        vals = [v.todense() if isinstance(v, BaseSparseNDArray) else v
+                for v in vals]
         if len(vals) == 1:
             return vals[0].copy()
         lead = vals[0].context

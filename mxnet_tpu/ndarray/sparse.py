@@ -117,6 +117,21 @@ class RowSparseNDArray(BaseSparseNDArray):
 
         return NDArray(fn(), ctx=self._ctx)
 
+    def _set_sparse_components(self, values, indices) -> None:
+        """In-place swap of (values, indices) — the sparse analog of
+        NDArray._set_data, used by autograd to write row_sparse gradients
+        into an attached grad buffer."""
+        self._data = values
+        self._aux["indices"] = indices
+
+    def zero(self) -> None:
+        """Reset to nnz=0 (Parameter.zero_grad on sparse grad buffers)."""
+        import jax.numpy as jnp
+
+        self._data = jnp.zeros((0,) + tuple(self._shape[1:]),
+                               self._data.dtype)
+        self._aux["indices"] = jnp.zeros((0,), self._aux["indices"].dtype)
+
     def retain(self, indices) -> "RowSparseNDArray":
         """Keep only the given rows (reference: sparse.retain)."""
         import jax.numpy as jnp
@@ -286,6 +301,25 @@ def array(source_array, ctx=None, dtype=None):
         pass
     raise MXNetError("sparse.array expects a scipy.sparse matrix or sparse "
                      "NDArray; use nd.array for dense inputs")
+
+
+def aggregate_rows(indices, values):
+    """Aggregate possibly-duplicated (indices, values) row pairs into
+    sorted-unique indices with segment-summed values.
+
+    EAGER-only (host-side unique gives the true dynamic row count — no
+    zero padding, so no spurious \"row 0 touched\" artifacts downstream).
+    Shared by autograd's sparse-cotangent leaf write and the row_sparse
+    optimizer kernels' pre-aggregation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ids_np = np.asarray(indices)
+    uids, inv = np.unique(ids_np, return_inverse=True)
+    vals = jax.ops.segment_sum(values, jnp.asarray(inv.reshape(-1)),
+                               num_segments=len(uids))
+    return jnp.asarray(uids), vals
 
 
 def _component(x, dtype):

@@ -128,7 +128,28 @@ def invoke(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
             outs = op.jitted(attrs)()
     else:
         jfn = op.jitted(attrs)
-        if (
+        if (op.name == "Embedding" and attrs.get("sparse_grad")
+                and autograd.is_recording()):
+            # row_sparse backward: record a custom pullback that yields a
+            # (indices, values) cotangent for the weight instead of a
+            # dense vocab-sized scatter (reference: EmbeddingOpBackward
+            # row_sparse path, src/operator/tensor/indexing_op.h)
+            outs = jfn(*arrays)
+            data_arr, weight_arr = arrays
+            vocab, dim = weight_arr.shape
+
+            def sparse_vjp(ct):
+                import jax.numpy as jnp
+
+                ids = jnp.clip(data_arr.astype(jnp.int32), 0,
+                               vocab - 1).reshape(-1)
+                vals = ct.reshape(-1, dim)
+                return [None, autograd._RowSparseCT(ids, vals,
+                                                    weight_arr.shape)]
+
+            autograd.record_node(sparse_vjp, arrays, [outs],
+                                 input_nds=inputs)
+        elif (
             autograd.is_recording()
             and op.differentiable
             and arrays

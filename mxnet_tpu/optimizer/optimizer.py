@@ -181,6 +181,72 @@ def _zeros_like32(weight):
     return NDArray(jnp.zeros(weight.shape, jnp.float32), ctx=weight.context)
 
 
+# ---------------------------------------------------------------------------
+# row_sparse lazy updates (reference: src/operator/optimizer_op.cc
+# SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl / AdamUpdateRspImpl — only the
+# rows present in the sparse gradient are read or written; untouched rows
+# see neither weight decay nor momentum decay).
+#
+# Gradients are pre-aggregated EAGERLY (sparse.aggregate_rows: host-side
+# unique -> true row count, no padding) before entering the jitted kernels,
+# so each kernel may assume unique scatter targets.
+# ---------------------------------------------------------------------------
+_rs_kernels: Dict[str, Any] = {}
+
+
+def _rs_grad(grad):
+    """(unique_ids, f32 values) from a RowSparseNDArray gradient."""
+    import jax.numpy as jnp
+
+    from ..ndarray.sparse import aggregate_rows
+
+    uids, vals = aggregate_rows(grad._aux["indices"], grad._data)
+    return uids, vals.astype(jnp.float32)
+
+
+def _get_rs_kernel(name: str):
+    kernel = _rs_kernels.get(name)
+    if kernel is not None:
+        return kernel
+    import jax
+    import jax.numpy as jnp
+
+    def prep(g, rows_w, wd, rescale, clip):
+        g = g * rescale
+        g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+        return g + wd * rows_w
+
+    if name == "sgd":
+        def kernel(w, uids, g, lr, wd, rescale, clip):
+            rows_w = w[uids].astype(jnp.float32)
+            g = prep(g, rows_w, wd, rescale, clip)
+            return w.at[uids].add((-lr * g).astype(w.dtype))
+    elif name == "sgd_mom":
+        def kernel(w, m, uids, g, lr, momentum, wd, rescale, clip):
+            rows_w = w[uids].astype(jnp.float32)
+            rows_m = m[uids]
+            g = prep(g, rows_w, wd, rescale, clip)
+            new_m = momentum * rows_m - lr * g
+            return (w.at[uids].add(new_m.astype(w.dtype)),
+                    m.at[uids].set(new_m))
+    elif name == "adam":
+        def kernel(w, mean, var, uids, g, lr, b1, b2, eps, wd, rescale,
+                   clip):
+            rows_w = w[uids].astype(jnp.float32)
+            g = prep(g, rows_w, wd, rescale, clip)
+            new_mean = b1 * mean[uids] + (1 - b1) * g
+            new_var = b2 * var[uids] + (1 - b2) * jnp.square(g)
+            step = lr * new_mean / (jnp.sqrt(new_var) + eps)
+            return (w.at[uids].add((-step).astype(w.dtype)),
+                    mean.at[uids].set(new_mean),
+                    var.at[uids].set(new_var))
+    else:
+        raise MXNetError(f"no row_sparse kernel {name!r}")
+    kernel = jax.jit(kernel)
+    _rs_kernels[name] = kernel
+    return kernel
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum and multi-precision (reference ~L700)."""
@@ -196,8 +262,25 @@ class SGD(Optimizer):
         return None
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         kw = self._common_kwargs(index)
+        if isinstance(grad, RowSparseNDArray):
+            uids, vals = _rs_grad(grad)
+            if state is None:
+                new_w = _get_rs_kernel("sgd")(
+                    weight._data, uids, vals, kw["lr"], kw["wd"],
+                    kw["rescale_grad"], kw["clip_gradient"])
+                weight._set_data(new_w)
+            else:
+                new_w, new_m = _get_rs_kernel("sgd_mom")(
+                    weight._data, state._data, uids, vals, kw["lr"],
+                    self.momentum, kw["wd"], kw["rescale_grad"],
+                    kw["clip_gradient"])
+                weight._set_data(new_w)
+                state._set_data(new_m)
+            return
         if state is None:
             _reg.invoke_by_name("sgd_update", [weight, grad], out=weight, **kw)
         else:
@@ -274,6 +357,8 @@ class Adam(Optimizer):
         return (_zeros_like32(weight), _zeros_like32(weight))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         t = self._index_update_count[index]
         kw = self._common_kwargs(index)
@@ -282,12 +367,20 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2**t
         kw["lr"] *= math.sqrt(coef2) / coef1
         mean, var = state
-        new_w, new_mean, new_var = _reg.invoke_by_name(
-            "adam_update", [weight, grad, mean, var], beta1=self.beta1,
-            beta2=self.beta2, epsilon=self.epsilon, **kw)
-        weight._set_data(new_w._data)
-        mean._set_data(new_mean._data)
-        var._set_data(new_var._data)
+        if isinstance(grad, RowSparseNDArray):
+            uids, vals = _rs_grad(grad)
+            new_w, new_mean, new_var = _get_rs_kernel("adam")(
+                weight._data, mean._data, var._data, uids, vals,
+                kw["lr"], self.beta1, self.beta2, self.epsilon,
+                kw["wd"], kw["rescale_grad"], kw["clip_gradient"])
+        else:
+            out = _reg.invoke_by_name(
+                "adam_update", [weight, grad, mean, var], beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon, **kw)
+            new_w, new_mean, new_var = (x._data for x in out)
+        weight._set_data(new_w)
+        mean._set_data(new_mean)
+        var._set_data(new_var)
 
 
 @register

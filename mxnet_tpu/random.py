@@ -36,10 +36,17 @@ def _jax():
 
 
 def seed(seed_state: Optional[int] = None, ctx="all") -> None:
-    """Seed the global generator (reference: mx.random.seed)."""
+    """Seed the global generator (reference: mx.random.seed).
+
+    Also seeds numpy's global RNG: initializers sample on the host via
+    numpy (the reference's CPU-side init path is likewise governed by
+    mx.random.seed), so reseeding must make parameter init reproducible."""
     if seed_state is None:
         seed_state = int(time.time() * 1e6) & 0x7FFFFFFF
     _state.key = _jax().random.PRNGKey(int(seed_state))
+    import numpy as np
+
+    np.random.seed(int(seed_state) & 0x7FFFFFFF)
 
 
 class _TraceKeyProvider:

@@ -35,6 +35,26 @@ def test_dist_sync_training_two_workers():
     assert res.stdout.count("dist train OK") == 2, res.stdout
 
 
+def test_launch_detects_nonrank0_crash(tmp_path):
+    """A crash in ANY rank must terminate the job promptly — rank 0 may be
+    blocked in a collective waiting for the dead peer."""
+    worker = tmp_path / "crashy.py"
+    worker.write_text(
+        "import os, sys, time\n"
+        "if os.environ['MX_PROC_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(120)\n")
+    import time as _time
+
+    t0 = _time.time()
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--force-cpu", "--", sys.executable, str(worker)],
+        timeout=60, capture_output=True, text=True)
+    assert res.returncode == 3
+    assert _time.time() - t0 < 30, "launcher failed to fan out the crash"
+
+
 def test_launch_cli_rejects_missing_command():
     res = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"), "-n", "2"],

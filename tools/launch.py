@@ -27,6 +27,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port() -> int:
@@ -70,13 +71,22 @@ def launch_local(num_workers: int, command, env_extra=None,
 
     rc = 0
     try:
-        for p in procs:
-            r = p.wait()
-            if r != 0 and rc == 0:
-                rc = r
-                for q in procs:
-                    if q.poll() is None:
+        # poll ALL workers: a crash in any rank (not just the first) must
+        # fan out SIGTERM immediately, or the peers block forever in
+        # collectives waiting for the dead rank
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                r = p.poll()
+                if r is None:
+                    continue
+                alive.remove(p)
+                if r != 0 and rc == 0:
+                    rc = r
+                    for q in alive:
                         q.send_signal(signal.SIGTERM)
+            if alive:
+                time.sleep(0.05)
     except KeyboardInterrupt:
         for q in procs:
             if q.poll() is None:
