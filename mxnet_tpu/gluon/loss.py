@@ -219,3 +219,45 @@ class CosineEmbeddingLoss(Loss):
                        F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification loss (reference: gluon/loss.py
+    CTCLoss ~L300 over src/operator/nn/ctc_loss.cc).
+
+    pred: (N, T, C) for layout 'NTC' (default) or (T, N, C) for 'TNC';
+    the LAST class index C-1 is the blank (the reference passes
+    blank_label='last' to the op).  label: (N, Lmax) 0-based class ids,
+    values < 0 are padding.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise ValueError(f"unsupported layout {layout!r}")
+        if label_layout not in ("NT", "TN"):
+            raise ValueError(f"unsupported label_layout {label_layout!r}")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = 0 if label_layout == "NT" else 1
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, dim1=0, dim2=1)
+        if self._label_layout == "TN":
+            label = F.swapaxes(label, dim1=0, dim2=1)
+        args = [pred, label]
+        if pred_lengths is not None:
+            args.append(pred_lengths)
+        if label_lengths is not None:
+            args.append(label_lengths)
+        loss = F.ctc_loss(*args,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="last")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+__all__.append("CTCLoss")

@@ -18,7 +18,7 @@ from ..ops import registry as _reg
 from .. import engine as _engine
 from .ndarray import NDArray, array, from_jax
 from . import random  # noqa: F401  (nd.random namespace)
-from .utils import save, load
+from .utils import save, load, save_legacy
 from . import contrib  # noqa: F401  (nd.contrib namespace)
 from . import sparse  # noqa: F401  (nd.sparse namespace)
 from .sparse import RowSparseNDArray, CSRNDArray

@@ -2,12 +2,25 @@
 
 Reference parity: src/ndarray/ndarray.cc NDArray::Save/Load (~L1500) and
 python mx.nd.save/load — a single file holding either a list of arrays or a
-str->array map.  We use our own container format (the reference's binary
-layout embeds mshadow TBlob internals that have no meaning here):
+str->array map.
 
-    magic 'MXTPND01' | u64 header_len | header JSON | raw little-endian buffers
+Two formats:
 
-bfloat16 is stored as raw uint16 payload with dtype recorded in the header.
+  * native:  magic 'MXTPND01' | u64 header_len | header JSON | raw buffers
+    (bfloat16 stored as raw uint16 payload, dtype in the header);
+  * legacy MXNet 1.x (READ + WRITE, for ecosystem checkpoint compat — the
+    format of src/ndarray/ndarray.cc NDArray::Save and c_api.cc
+    MXNDArraySave):
+        u64 0x112 (kMXAPINDListMagic) | u64 reserved
+        u64 count | count * NDArray records
+        u64 name_count | name_count * (u64 len | bytes)
+    each dense NDArray record being
+        u32 0xF993FAC9 (V2 magic) | i32 stype(=0 dense)
+        u32 ndim | i64 dims[ndim]          (V1 files: u32 dims)
+        i32 dev_type | i32 dev_id | i32 type_flag | raw data
+
+``load`` dispatches on the leading magic, so reference-produced .params /
+nd.save files open transparently.
 """
 from __future__ import annotations
 
@@ -20,6 +33,16 @@ import numpy as np
 from ..base import MXNetError, dtype_np
 
 _MAGIC = b"MXTPND01"
+
+# legacy constants (reference: src/ndarray/ndarray.cc ~L1500,
+# c_api.cc MXNDArraySave)
+_LEGACY_LIST_MAGIC = 0x112
+_LEGACY_V1_MAGIC = 0xF993FAC8
+_LEGACY_V2_MAGIC = 0xF993FAC9
+# mshadow type flags (3rdparty/mshadow/mshadow/base.h TypeFlag)
+_LEGACY_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
+_LEGACY_FLAGS = {v: k for k, v in _LEGACY_DTYPES.items()}
 
 
 def _to_bytes(arr: np.ndarray):
@@ -71,6 +94,140 @@ def save(fname: str, data) -> None:
             f.write(p)
 
 
+# ---------------------------------------------------------------------------
+# legacy MXNet 1.x format
+# ---------------------------------------------------------------------------
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt: str):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def raw(self, n: int) -> bytes:
+        out = self.buf[self.pos:self.pos + n]
+        if len(out) != n:
+            raise MXNetError("legacy NDArray file truncated")
+        self.pos += n
+        return out
+
+
+def _legacy_read_ndarray(r: _Reader) -> np.ndarray:
+    magic = r.read("I")
+    if magic == _LEGACY_V2_MAGIC:
+        stype = r.read("i")
+        if stype != 0:
+            raise MXNetError(
+                "legacy sparse NDArray records are not supported; re-save "
+                "densely (kDefaultStorage)")
+        dim_fmts = ("q", "I")  # 1.5+ int64 TShape dims; pre-1.5 uint32
+    elif magic == _LEGACY_V1_MAGIC:
+        dim_fmts = ("I",)
+    else:
+        raise MXNetError(f"bad legacy NDArray magic {magic:#x}")
+    ndim = r.read("I")
+    if ndim > 32:
+        raise MXNetError(f"implausible legacy ndim {ndim}")
+
+    # The dim width is not recorded in the file, so validate each candidate
+    # parse against everything that follows it: plausible dims, a plausible
+    # (dev_type, dev_id, type_flag) triple, and a payload that fits in the
+    # remaining buffer.  (A wrong-width parse passes none of these: e.g.
+    # uint32 dims (3,4) read as one int64 is ~1.7e10 elements.)
+    start = r.pos
+    parses = []
+    for fmt in dim_fmts:
+        r.pos = start
+        try:
+            dims = [r.read(fmt) for _ in range(ndim)] if ndim else []
+            dev_type, dev_id = r.read("ii")
+            type_flag = r.read("i")
+        except struct.error:
+            continue
+        name = _LEGACY_DTYPES.get(type_flag)
+        count = int(np.prod(dims)) if dims else 1
+        itemsize = 2 if name == "bfloat16" else (
+            np.dtype(name).itemsize if name else 0)
+        ok = (name is not None
+              and all(0 <= d < (1 << 40) for d in dims)
+              and 1 <= dev_type <= 16 and 0 <= dev_id < 4096
+              and r.pos + count * itemsize <= len(r.buf))
+        parses.append((ok, dims, name, count, itemsize, r.pos))
+    for ok, dims, name, count, itemsize, pos in parses:
+        if ok:
+            r.pos = pos
+            break
+    else:
+        raise MXNetError(
+            "cannot parse legacy NDArray record (unknown dim width / "
+            "type flag)")
+    if name == "bfloat16":
+        raw = r.raw(count * 2)
+        return np.frombuffer(raw, np.uint16).reshape(dims).view(
+            dtype_np("bfloat16"))
+    dt = np.dtype(name)
+    raw = r.raw(count * dt.itemsize)
+    return np.frombuffer(raw, dt).reshape(dims)
+
+
+def _load_legacy(buf: bytes):
+    from . import array
+
+    r = _Reader(buf)
+    magic, _reserved = r.read("QQ")
+    assert magic == _LEGACY_LIST_MAGIC
+    n = r.read("Q")
+    arrays = [_legacy_read_ndarray(r) for _ in range(n)]
+    n_names = r.read("Q")
+    names = []
+    for _ in range(n_names):
+        ln = r.read("Q")
+        names.append(r.raw(ln).decode())
+    nds = [array(a, dtype=a.dtype) for a in arrays]
+    if names:
+        if len(names) != len(nds):
+            raise MXNetError("legacy file: name/array count mismatch")
+        return dict(zip(names, nds))
+    return nds
+
+
+def save_legacy(fname: str, data) -> None:
+    """Write the MXNet 1.x binary format so checkpoints round-trip into
+    reference tooling (same layout _load_legacy reads)."""
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LEGACY_LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for nd_ in arrays:
+            arr = nd_.asnumpy()
+            dtname, raw = _to_bytes(arr)
+            if dtname not in _LEGACY_FLAGS:
+                raise MXNetError(f"dtype {dtname} has no legacy type flag")
+            f.write(struct.pack("<Ii", _LEGACY_V2_MAGIC, 0))
+            f.write(struct.pack("<I", arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack("<q", d))
+            f.write(struct.pack("<iii", 1, 0, _LEGACY_FLAGS[dtname]))
+            f.write(raw)
+        f.write(struct.pack("<Q", len(names)))
+        for name in names:
+            b = name.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
 def load(fname: str):
     from . import array
     from .ndarray import NDArray
@@ -78,6 +235,9 @@ def load(fname: str):
     with open(fname, "rb") as f:
         magic = f.read(8)
         if magic != _MAGIC:
+            if (len(magic) == 8
+                    and struct.unpack("<Q", magic)[0] == _LEGACY_LIST_MAGIC):
+                return _load_legacy(magic + f.read())
             raise MXNetError(f"{fname}: not an mxnet_tpu NDArray file")
         (hlen,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(hlen).decode())

@@ -73,3 +73,149 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
     out = jnp.einsum("nqk,nkd->nqd", attention, v)
     return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
         L, B, heads * D)
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling / deformable convolution / CTC (r2 compat tail)
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, output_size=1):
+    """Adaptive average pooling to a fixed output grid (reference:
+    src/operator/contrib/adaptive_avg_pooling.cc).
+
+    Output cell (i, j) averages input window [floor(i*H/H0), ceil((i+1)*H/H0))
+    — computed via a 2-D integral image so uneven windows stay one fused
+    gather, not a python loop per cell.
+    """
+    import numpy as np
+
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh, ow = (int(output_size[0]), int(output_size[-1]))
+    n, c, h, w = data.shape
+    x32 = data.astype(jnp.float32)
+    # integral image with a leading zero row/col
+    integ = jnp.pad(jnp.cumsum(jnp.cumsum(x32, axis=2), axis=3),
+                    ((0, 0), (0, 0), (1, 0), (1, 0)))
+    hs = np.floor(np.arange(oh) * h / oh).astype(np.int32)
+    he = np.ceil((np.arange(oh) + 1) * h / oh).astype(np.int32)
+    ws = np.floor(np.arange(ow) * w / ow).astype(np.int32)
+    we = np.ceil((np.arange(ow) + 1) * w / ow).astype(np.int32)
+    area = ((he - hs)[:, None] * (we - ws)[None, :]).astype(np.float32)
+    s = (integ[:, :, he][:, :, :, we] - integ[:, :, hs][:, :, :, we]
+         - integ[:, :, he][:, :, :, ws] + integ[:, :, hs][:, :, :, ws])
+    return (s / area).astype(data.dtype)
+
+
+@register("histogram")
+def histogram(data, *bin_arr, bin_cnt=None, range=None, bins=10):
+    """np.histogram semantics (reference: src/operator/tensor/histogram.cc).
+
+    Either bin_cnt+range (uniform bins) or an explicit bin-edge array.
+    Returns (counts, bin_edges)."""
+    x = data.reshape(-1).astype(jnp.float32)
+    if bin_arr:
+        edges = bin_arr[0].astype(jnp.float32)
+        nbins = edges.shape[0] - 1
+        idx = jnp.searchsorted(edges, x, side="right") - 1
+        # right-most edge is inclusive (numpy semantics)
+        idx = jnp.where(x == edges[-1], nbins - 1, idx)
+        valid = (idx >= 0) & (idx < nbins)
+        counts = jnp.zeros((nbins,), jnp.int32).at[
+            jnp.where(valid, idx, 0)].add(valid.astype(jnp.int32))
+        return counts, edges
+    cnt = int(bin_cnt if bin_cnt is not None else bins)
+    if range is None:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = jnp.asarray(range[0], jnp.float32), jnp.asarray(
+            range[1], jnp.float32)
+    span = jnp.where(hi > lo, hi - lo, 1.0)
+    idx = jnp.floor((x - lo) / span * cnt).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, cnt - 1)
+    valid = (x >= lo) & (x <= hi)
+    counts = jnp.zeros((cnt,), jnp.int32).at[
+        jnp.where(valid, idx, 0)].add(valid.astype(jnp.int32))
+    edges = lo + (hi - lo) * jnp.arange(cnt + 1, dtype=jnp.float32) / cnt
+    return counts, edges
+
+
+def _bilinear_gather(img, y, x):
+    """img (C, H, W); y/x arbitrary equal shapes of float coords.
+    Zero padding outside (reference deformable conv im2col behavior)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def at(yy, xx):
+        inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+        v = img[:, yc, xc]
+        return jnp.where(inside, v, 0.0)
+
+    return (at(y0, x0) * (wy0 * wx0) + at(y0, x0 + 1) * (wy0 * wx1)
+            + at(y0 + 1, x0) * (wy1 * wx0) + at(y0 + 1, x0 + 1) * (wy1 * wx1))
+
+
+@register("_contrib_DeformableConvolution")
+def deformable_convolution(data, offset, weight, *bias, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=1, num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout=None):
+    """Deformable convolution v1 (reference: src/operator/contrib/
+    deformable_convolution.cc — Dai et al. 2017).
+
+    offset: (N, 2*dg*kh*kw, H0, W0), ordered (y, x) per kernel tap.
+    Implementation: bilinear-sample a deformed im2col volume, then one
+    einsum onto the MXU — the gather is the only non-matmul work.
+    """
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    n, cin, h, w = data.shape
+    h0 = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    w0 = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    x32 = data.astype(jnp.float32)
+    off = offset.astype(jnp.float32).reshape(n, dg, kh * kw, 2, h0, w0)
+
+    base_y = (jnp.arange(h0) * sh - ph)[:, None]  # (h0, 1)
+    base_x = (jnp.arange(w0) * sw - pw)[None, :]  # (1, w0)
+    ky = (jnp.arange(kh) * dh)[:, None].repeat(kw, 1).reshape(-1)  # (kh*kw,)
+    kx = (jnp.arange(kw) * dw)[None, :].repeat(kh, 0).reshape(-1)
+
+    # sample positions: (dg, kh*kw, h0, w0)
+    y_pos = base_y[None, None] + ky[None, :, None, None] + off[:, :, :, 0]
+    x_pos = base_x[None, None] + kx[None, :, None, None] + off[:, :, :, 1]
+
+    cpg = cin // dg  # channels per deformable group
+
+    def sample_one(img, yp, xp):
+        # img (cin, h, w); yp/xp (dg, K, h0, w0) -> (cin, K, h0, w0)
+        outs = []
+        for g in range(dg):
+            outs.append(_bilinear_gather(img[g * cpg:(g + 1) * cpg],
+                                         yp[g], xp[g]))
+        return jnp.concatenate(outs, axis=0)
+
+    cols = jax.vmap(sample_one)(x32, y_pos, x_pos)  # (n, cin, K, h0, w0)
+    wmat = weight.astype(jnp.float32).reshape(num_filter, cin // num_group,
+                                              kh * kw)
+    if num_group == 1:
+        out = jnp.einsum("nckhw,fck->nfhw", cols, wmat)
+    else:
+        cg = cin // num_group
+        fg = num_filter // num_group
+        cols_g = cols.reshape(n, num_group, cg, kh * kw, h0, w0)
+        wmat_g = wmat.reshape(num_group, fg, cg, kh * kw)
+        out = jnp.einsum("ngckhw,gfck->ngfhw", cols_g, wmat_g).reshape(
+            n, num_filter, h0, w0)
+    out = out.astype(data.dtype)
+    if not no_bias and bias:
+        out = out + bias[0].reshape(1, -1, 1, 1)
+    return out

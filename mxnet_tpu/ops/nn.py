@@ -554,3 +554,90 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
 
     fwd.defvjp(f, b)
     return fwd(data)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc + gluon CTCLoss)
+# ---------------------------------------------------------------------------
+def _ctc_forward(logp, t_len, ext, s_valid, skip_ok):
+    """Log-space CTC alpha recursion for ONE sequence.
+
+    logp: (T, C) log-softmax scores; ext: (S,) extended label seq
+    (blank-interleaved, S = 2*Lmax+1); s_valid: number of valid ext slots
+    (2*label_len+1); skip_ok: (S,) whether the s-2 skip transition is legal.
+    Returns the log-likelihood; differentiating this scan IS the standard
+    CTC gradient.
+    """
+    NEG = -1e30
+    S = ext.shape[0]
+    alpha0 = jnp.full((S,), NEG)
+    alpha0 = alpha0.at[0].set(logp[0, ext[0]])
+    alpha0 = alpha0.at[1].set(jnp.where(s_valid > 1, logp[0, ext[1]], NEG))
+
+    def step(alpha, lp_t):
+        a1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        a2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+        a2 = jnp.where(skip_ok, a2, NEG)
+        m = jnp.maximum(alpha, jnp.maximum(a1, a2))
+        tot = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m)
+                          + jnp.exp(a2 - m))
+        new = tot + lp_t[ext]
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas])  # (T, S)
+    final = alphas[t_len - 1]
+    last = final[s_valid - 1]
+    # empty label (s_valid == 1): only the all-blank path exists — do not
+    # logsumexp final[0] with itself
+    prev = jnp.where(s_valid > 1, final[jnp.maximum(s_valid - 2, 0)], NEG)
+    m = jnp.maximum(last, prev)
+    return m + jnp.log(jnp.exp(last - m) + jnp.exp(prev - m))
+
+
+@register("ctc_loss")
+def ctc_loss(data, label, *lengths, use_data_lengths=False,
+             use_label_lengths=False, blank_label="first"):
+    """Connectionist Temporal Classification loss.
+
+    data: (T, N, C) activations (softmax applied internally, reference
+    semantics); label: (N, Lmax) class ids, values < 0 are padding.
+    Optional data_lengths/label_lengths NDArrays follow positionally when
+    the corresponding use_* flag is set.  blank_label 'first' -> blank id
+    0 (labels use 1..C-1); 'last' -> blank id C-1 (labels use 0..C-2).
+    Returns per-example loss (N,).
+    """
+    T, N, C = data.shape
+    blank = 0 if blank_label == "first" else C - 1
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+
+    li = 0
+    if use_data_lengths:
+        t_lens = lengths[li].astype(jnp.int32)
+        li += 1
+    else:
+        t_lens = jnp.full((N,), T, jnp.int32)
+    lab = label.astype(jnp.int32)
+    if use_label_lengths:
+        l_lens = lengths[li].astype(jnp.int32)
+    else:
+        # padding convention (reference ctc_loss doc): blank_label='first'
+        # reserves id 0 for blank AND uses 0 as label padding (real labels
+        # are 1..C-1); 'last' uses -1 padding (labels 0..C-2)
+        if blank_label == "first":
+            l_lens = (lab > 0).sum(axis=1).astype(jnp.int32)
+        else:
+            l_lens = (lab >= 0).sum(axis=1).astype(jnp.int32)
+    lab = jnp.maximum(lab, 0)
+
+    Lmax = lab.shape[1]
+    blanks = jnp.full((N, Lmax), blank, jnp.int32)
+    ext = jnp.stack([blanks, lab], axis=2).reshape(N, 2 * Lmax)
+    ext = jnp.concatenate([ext, blanks[:, :1]], axis=1)  # (N, 2Lmax+1)
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((N, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+    s_valid = 2 * l_lens + 1
+    ll = jax.vmap(_ctc_forward, in_axes=(1, 0, 0, 0, 0))(
+        logp, t_lens, ext, s_valid, skip_ok)
+    return (-ll).astype(data.dtype)
