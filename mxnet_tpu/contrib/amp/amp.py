@@ -95,10 +95,21 @@ def scale_loss(loss, trainer):
         yield loss
         return
     trainer._scale = 1.0 / scaler.loss_scale
-    if isinstance(loss, (list, tuple)):
-        yield [l * scaler.loss_scale for l in loss]
-    else:
-        yield loss * scaler.loss_scale
+    from ... import autograd as _ag
+
+    # the scale-multiply must land on the tape even when scale_loss is
+    # used outside the record scope (both styles appear in reference
+    # scripts); set_recording appends to the existing tape — entering a
+    # fresh record() scope here would DROP it
+    prev = _ag.set_recording(True)
+    try:
+        if isinstance(loss, (list, tuple)):
+            scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            scaled = loss * scaler.loss_scale
+    finally:
+        _ag.set_recording(prev)
+    yield scaled
     overflow = scaler.has_overflow(trainer._params)
     scaler.update_scale(overflow)
     if overflow:

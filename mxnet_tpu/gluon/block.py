@@ -438,6 +438,11 @@ class HybridBlock(Block):
     def _call_cached_op(self, *args):
         if self._cached_op is None:
             self._cached_op = CachedOp(self, self._flags)
+        from .. import profiler
+
+        if profiler.is_recording():
+            return profiler.timed_call(f"CachedOp:{type(self).__name__}",
+                                       self._cached_op, *args)
         return self._cached_op(*args)
 
     def _infer_and_retry_params(self, *args) -> None:

@@ -105,6 +105,19 @@ def invoke(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
     `ctx` only matters for zero-input (creation) ops; otherwise outputs
     follow their inputs' device, as in the reference.
     """
+    from .. import profiler
+
+    if profiler.is_recording() and not any(_is_tracer(x._data)
+                                           for x in inputs):
+        # per-op aggregate stats (reference: ThreadedEngine profiler
+        # brackets -> aggregate_stats.cc).  Blocking for the timing
+        # serializes dispatch — profiling overhead, as in the reference.
+        return profiler.timed_call(op.name, _invoke_impl, op, inputs,
+                                   out=out, ctx=ctx, **attrs)
+    return _invoke_impl(op, inputs, out=out, ctx=ctx, **attrs)
+
+
+def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
     from ..ndarray import NDArray
     from .. import autograd
 

@@ -268,9 +268,16 @@ class DataParallelStep:
         # baked in at trace time, so scope the override around the jit call.
         from ..ops import pallas as _pk
 
+        from .. import profiler
+
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
         with _pk.compute_on(mesh_platform):
-            self.params, self.opt_state, loss = self._jitted(
+            run = self._jitted
+            if profiler.is_recording():
+                run = (lambda *a: profiler.timed_call(
+                    f"FusedStep:{type(self.block).__name__}",
+                    self._jitted, *a))
+            self.params, self.opt_state, loss = run(
                 self.params, self.opt_state, key, data_arr, label_arr)
         self._step_count += 1
         return loss

@@ -20,6 +20,55 @@ _config = {"filename": "profile.json", "profile_all": False,
            "trace_dir": None}
 _running = False
 
+# ---------------------------------------------------------------------------
+# aggregate per-op stats (reference: src/profiler/aggregate_stats.cc — the
+# table printed by mx.profiler.dumps()).  Populated by the op dispatch layer
+# (ops/registry.invoke) and the compiled-step executors while a profile is
+# running: on TPU the engine-level hook of the reference
+# (ThreadedEngine::ExecuteOprBlock profiler brackets) becomes a hook at the
+# two places work is issued — eager op dispatch and jitted step execution.
+# ---------------------------------------------------------------------------
+_aggregate: dict = {}
+
+
+def is_recording() -> bool:
+    """True while op timings should be collected (profile running)."""
+    return _running
+
+
+def record_op(name: str, seconds: float, memory: int = 0) -> None:
+    """Record one execution of `name` (called from the dispatch layer)."""
+    ent = _aggregate.get(name)
+    if ent is None:
+        _aggregate[name] = [1, seconds, seconds, seconds, memory]
+    else:
+        ent[0] += 1
+        ent[1] += seconds
+        ent[2] = min(ent[2], seconds)
+        ent[3] = max(ent[3], seconds)
+        ent[4] = max(ent[4], memory)
+
+
+def reset_stats() -> None:
+    _aggregate.clear()
+
+
+def timed_call(name: str, fn, *args, **kwargs):
+    """Run fn(*args, **kwargs), block on every jax-array leaf of the result,
+    and record the wall time under `name`.  The single shared scaffold for
+    all profiled call sites (op dispatch, CachedOp, fused step)."""
+    import time as _time
+
+    import jax
+
+    t0 = _time.perf_counter()
+    result = fn(*args, **kwargs)
+    leaves = [getattr(x, "_data", x) for x in jax.tree_util.tree_leaves(result)]
+    jax.block_until_ready([x for x in leaves
+                           if not isinstance(x, (int, float, str, bool))])
+    record_op(name, _time.perf_counter() - t0)
+    return result
+
 
 def set_config(**kwargs):
     """Accepts the reference's kwargs (profile_all, profile_symbolic,
@@ -74,8 +123,47 @@ def dump(finished=True, profile_process="worker"):
         stop()
 
 
-def dumps(reset=False):
-    return f"profile trace directory: {_trace_dir()}"
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Ranked per-op aggregate table (reference: MXAggregateProfileStatsPrint
+    over aggregate_stats.cc) plus the jax trace location.
+
+    format: 'table' (human) or 'json' (machine-readable list of rows)."""
+    if format not in ("table", "json"):
+        raise MXNetError(f"unsupported dumps format {format!r}")
+    if not _aggregate:
+        if format == "json":
+            import json as _json
+
+            return _json.dumps([])
+        return (f"profile trace directory: {_trace_dir()}\n"
+                "(no per-op stats recorded — run ops between profiler."
+                "start() and stop())")
+    key = {"total": lambda e: e[1][1], "count": lambda e: e[1][0],
+           "avg": lambda e: e[1][1] / e[1][0], "min": lambda e: e[1][2],
+           "max": lambda e: e[1][3]}.get(sort_by, lambda e: e[1][1])
+    rows = sorted(_aggregate.items(), key=key, reverse=not ascending)
+    if format == "json":
+        import json as _json
+
+        out = [{"name": n, "count": c, "total_ms": t * 1e3,
+                "avg_ms": t / c * 1e3, "min_ms": mn * 1e3, "max_ms": mx * 1e3}
+               for n, (c, t, mn, mx, _m) in rows]
+        if reset:
+            reset_stats()
+        return _json.dumps(out)
+    name_w = max(24, max(len(n) for n, _ in rows) + 2)
+    lines = ["Profile Statistics:",
+             f"{'Name':<{name_w}}{'Calls':>8}{'Total(ms)':>12}"
+             f"{'Avg(ms)':>10}{'Min(ms)':>10}{'Max(ms)':>10}",
+             "-" * (name_w + 50)]
+    for name, (count, total, mn, mx, _mem) in rows:
+        lines.append(
+            f"{name:<{name_w}}{count:>8}{total * 1e3:>12.3f}"
+            f"{total / count * 1e3:>10.3f}{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
+    lines.append(f"\nprofile trace directory: {_trace_dir()}")
+    if reset:
+        reset_stats()
+    return "\n".join(lines)
 
 
 class scope:

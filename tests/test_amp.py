@@ -1,0 +1,96 @@
+"""AMP end-to-end (reference: python/mxnet/contrib/amp/amp.py —
+init/init_trainer/scale_loss; BASELINE config 2 requires the AMP workflow).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.contrib import amp
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_amp_bf16_workflow_trains():
+    mx.random.seed(0)
+    amp.init(target_dtype="bfloat16")
+    net = _net()
+    amp.convert_hybrid_block(net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    assert trainer._optimizer.multi_precision
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, 16).astype(np.float32)
+    import ml_dtypes
+
+    xb = nd.array(x.astype(ml_dtypes.bfloat16), dtype=ml_dtypes.bfloat16)
+    first = last = None
+    for _ in range(30):
+        with autograd.record():
+            out = net(xb)
+            loss = loss_fn(out, nd.array(y))
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(16)
+        v = float(loss.mean().asnumpy().astype(np.float32))
+        first = first if first is not None else v
+        last = v
+    assert last < first, (first, last)
+    # master-weight path keeps bf16 exposed weights
+    assert net[0].weight.data().dtype == ml_dtypes.bfloat16
+
+
+def test_amp_fp16_loss_scaling_trains():
+    mx.random.seed(1)
+    amp.init(target_dtype="float16")
+    net = _net()
+    amp.convert_hybrid_block(net, target_dtype="float16")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    assert scaler is not None and scaler.loss_scale > 1
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.RandomState(2).rand(8, 8).astype(np.float16),
+                 dtype=np.float16)
+    y = nd.array(np.random.RandomState(3).randint(0, 4, 8).astype(np.float32))
+    first = last = None
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(8)
+        v = float(loss.mean().asnumpy().astype(np.float32))
+        first = first if first is not None else v
+        last = v
+    assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_amp_fp16_overflow_recovery():
+    amp.init(target_dtype="float16")
+    net = _net()
+    net.cast("float16")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    scale0 = scaler.loss_scale
+    x = nd.array(np.random.rand(4, 8).astype(np.float16), dtype=np.float16)
+    with autograd.record():
+        out = net(x)
+        loss = (out * 6e4).sum()  # overflows fp16 grads
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    # overflow detected: scale halved, grads zeroed so step is a no-op
+    assert scaler.loss_scale < scale0
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            assert float(np.abs(p.grad().asnumpy().astype(np.float32)).sum()) == 0.0

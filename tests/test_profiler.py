@@ -1,0 +1,84 @@
+"""Profiler aggregate stats (reference: src/profiler/aggregate_stats.cc;
+mx.profiler.dumps() must answer \"which op is slow\" for a model step).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import profiler
+
+
+def _resnet_ish():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_dumps_ranks_ops_for_model_step(tmp_path):
+    profiler.reset_stats()
+    profiler.set_config(filename=str(tmp_path / "prof.json"),
+                        profile_all=True)
+    net = _resnet_ish()
+    x = nd.array(np.random.rand(2, 3, 16, 16).astype(np.float32))
+    net(x)  # resolve deferred init outside the profile window
+    profiler.start()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    y = nd.array(np.random.randint(0, 10, 2).astype(np.float32))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    profiler.stop()
+    table = profiler.dumps()
+    assert "Profile Statistics" in table
+    for op_name in ("Convolution", "BatchNorm", "Pooling", "FullyConnected"):
+        assert op_name in table, table
+    assert "Calls" in table and "Total(ms)" in table
+    # ranked: rows are sorted by total time descending (use the json form)
+    import json
+
+    rows = json.loads(profiler.dumps(format="json"))
+    totals = [r["total_ms"] for r in rows]
+    assert totals == sorted(totals, reverse=True), totals
+
+
+def test_dumps_includes_cached_op(tmp_path):
+    profiler.reset_stats()
+    profiler.set_config(filename=str(tmp_path / "prof2.json"))
+    net = _resnet_ish()
+    net.hybridize()
+    x = nd.array(np.random.rand(2, 3, 16, 16).astype(np.float32))
+    net(x)  # compile outside the window
+    profiler.start()
+    net(x)
+    profiler.stop()
+    table = profiler.dumps(reset=True)
+    assert "CachedOp:HybridSequential" in table
+    # reset=True clears the aggregation
+    assert "no per-op stats" in profiler.dumps()
+
+
+def test_profiled_cached_op_with_nested_outputs(tmp_path):
+    # regression: profiling a hybridized block whose forward returns a
+    # nested (output, [states...]) pytree must not crash
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize(mx.init.Xavier())
+    cell.hybridize()
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    states = cell.begin_state(2)
+    cell(x, states)  # compile outside the window
+    profiler.set_config(filename=str(tmp_path / "prof3.json"))
+    profiler.start()
+    out, new_states = cell(x, states)
+    profiler.stop()
+    assert "CachedOp:LSTMCell" in profiler.dumps(reset=True)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_stats_not_collected_when_stopped():
+    profiler.reset_stats()
+    x = nd.array(np.random.rand(4, 4).astype(np.float32))
+    (x + x).asnumpy()
+    assert "no per-op stats" in profiler.dumps()
