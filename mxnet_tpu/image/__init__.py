@@ -1,7 +1,13 @@
 """Image API (reference: python/mxnet/image/image.py ~L1-1500 — imdecode,
-imresize, augmenters, ImageIter; backed by src/operator/image/ ops)."""
+imresize, augmenters, ImageIter; backed by src/operator/image/ ops) and the
+detection pipeline (python/mxnet/image/detection.py — ImageDetIter)."""
 from .image import (imdecode, imencode, imread, imresize, resize_short,
                     fixed_crop, center_crop, random_crop, color_normalize,
                     CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
                     RandomCropAug, CenterCropAug, HorizontalFlipAug,
-                    CastAug, ImageIter)
+                    CastAug, BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, HueJitterAug, ColorJitterAug,
+                    LightingAug, RandomGrayAug, ColorNormalizeAug, ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)

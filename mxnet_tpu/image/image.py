@@ -18,7 +18,9 @@ __all__ = ["imdecode", "imencode", "imread", "imresize", "resize_short",
            "fixed_crop", "center_crop", "random_crop", "color_normalize",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
            "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
-           "ImageIter"]
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+           "ColorNormalizeAug", "ImageIter"]
 
 
 def _cv2():
@@ -201,6 +203,127 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+def _as_float(src):
+    return np.asarray(src, np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (reference: image.py BrightnessJitterAug)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return _as_float(src) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self._coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        src = _as_float(src)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray.mean() * (1 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self._coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        src = _as_float(src)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate RGB about the gray axis by U(-hue, hue)*180deg (reference:
+    image.py HueJitterAug yiq-rotation formulation)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self._tyiq = np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], np.float32)
+        self._ityiq = np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        src = _as_float(src)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = self._ityiq @ bt @ self._tyiq
+        return src @ t.T
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = [a for a in (
+            BrightnessJitterAug(brightness) if brightness else None,
+            ContrastJitterAug(contrast) if contrast else None,
+            SaturationJitterAug(saturation) if saturation else None) if a]
+
+    def __call__(self, src):
+        augs = list(self._augs)
+        pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference: image.py LightingAug / AlexNet)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return _as_float(src) + rgb.reshape(1, 1, 3)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self._coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            gray = (_as_float(src) * self._coef).sum(axis=2, keepdims=True)
+            return np.broadcast_to(gray, src.shape).copy()
+        return src
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(_as_float(src), self.mean, self.std)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -216,6 +339,26 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(
+            pca_noise,
+            eigval=np.array([55.46, 4.794, 1.148]),
+            eigvec=np.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]])))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is not None or std is not None:
+        if mean is True or mean is None:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True or std is None:
+            std = np.array([58.395, 57.12, 57.375])
+        if mean is not False:
+            auglist.append(ColorNormalizeAug(mean, std))
     auglist.append(CastAug())
     return auglist
 

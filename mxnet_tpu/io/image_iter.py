@@ -27,13 +27,20 @@ __all__ = ["ImageRecordIter"]
 
 
 class ImageRecordIter(DataIter):
+    """shuffle_chunk_size (MB) bounds shuffle memory in the NATIVE pipeline
+    (chunk-local reads, reference semantics); the pure-Python fallback
+    reads by index and always full-shuffles — a strictly better mix, so
+    the parameter is a no-op there."""
+
     def __init__(self, path_imgrec=None, path_imgidx=None, data_shape=None,
                  batch_size=1, label_width=1, shuffle=False,
                  shuffle_chunk_size=0, preprocess_threads=4, prefetch_buffer=4,
                  rand_crop=False, rand_mirror=False, resize=0,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, seed=0, round_batch=True,
-                 ctx=None, dtype="float32", **kwargs):
+                 brightness=0.0, contrast=0.0, saturation=0.0,
+                 random_h=0, random_s=0, random_l=0, pca_noise=0.0,
+                 shuffle_chunk_seed=0, ctx=None, dtype="float32", **kwargs):
         super().__init__(batch_size)
         if path_imgrec is None or data_shape is None:
             raise MXNetError("path_imgrec and data_shape are required")
@@ -48,6 +55,24 @@ class ImageRecordIter(DataIter):
         self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
         self._std = np.array([std_r, std_g, std_b], np.float32)
         self._scale = scale
+        # color jitter for the pure-Python fallback path (the native path
+        # applies the same jitters in C++) — constructor args must mean
+        # the same thing whichever pipeline loaded
+        from ..image import (ColorJitterAug, HueJitterAug, LightingAug)
+
+        self._color_augs = []
+        b = brightness + random_l / 255.0
+        s = saturation + random_s / 255.0
+        if b or contrast or s:
+            self._color_augs.append(ColorJitterAug(b, contrast, s))
+        if random_h:
+            self._color_augs.append(HueJitterAug(random_h / 180.0))
+        if pca_noise > 0:
+            self._color_augs.append(LightingAug(
+                pca_noise, eigval=np.array([55.46, 4.794, 1.148]),
+                eigvec=np.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]])))
         self._dtype = dtype
         self._round_batch = round_batch
         self._rng = pyrandom.Random(seed)
@@ -65,13 +90,22 @@ class ImageRecordIter(DataIter):
 
         if _native_mod.available() and dtype == "float32":
             try:
+                # HSL jitter mapping (reference image_aug_default.cc):
+                # random_h is in degrees (OpenCV hue unit = 2 deg);
+                # random_s / random_l are on the 0-255 scale -> fractions
                 self._native = _native_mod.NativeImageIter(
                     path_imgrec, batch_size, self.data_shape,
                     preprocess_threads=self._threads, shuffle=shuffle,
-                    seed=seed, resize=resize, rand_crop=rand_crop,
+                    seed=seed ^ shuffle_chunk_seed, resize=resize,
+                    rand_crop=rand_crop,
                     rand_mirror=rand_mirror, scale=scale,
                     mean=self._mean, std=self._std,
-                    label_width=label_width, prefetch=self._prefetch)
+                    label_width=label_width, prefetch=self._prefetch,
+                    brightness=brightness + random_l / 255.0,
+                    contrast=contrast,
+                    saturation=saturation + random_s / 255.0,
+                    hue=random_h / 2.0, pca_noise=pca_noise,
+                    shuffle_chunk_mb=float(shuffle_chunk_size))
                 self._native_batches = (
                     self._native.num_records // batch_size
                     if round_batch else
@@ -162,7 +196,9 @@ class ImageRecordIter(DataIter):
                         img = img_mod.imresize(img, w, h)
                 if self._rand_mirror and self._rng.random() < 0.5:
                     img = img[:, ::-1]
-                arr = img.astype(np.float32)
+                for aug in self._color_augs:
+                    img = aug(img)
+                arr = np.asarray(img, np.float32)
                 arr = (arr - self._mean) / self._std * self._scale
                 data[slot] = arr.transpose(2, 0, 1)
                 lab = np.atleast_1d(np.asarray(header.label, np.float32))

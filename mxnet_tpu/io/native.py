@@ -42,6 +42,10 @@ def _load():
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
         ctypes.c_float]
+    lib.MXIOImageIterCreate2.restype = ctypes.c_void_p
+    lib.MXIOImageIterCreate2.argtypes = (
+        lib.MXIOImageIterCreate.argtypes
+        + [ctypes.c_float, ctypes.c_float, ctypes.c_float])
     lib.MXIOImageIterNext.restype = ctypes.c_int
     lib.MXIOImageIterNext.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_float),
@@ -69,7 +73,8 @@ class NativeImageIter:
                  preprocess_threads=4, shuffle=False, seed=0, resize=0,
                  rand_crop=False, rand_mirror=False, scale=1.0,
                  mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), label_width=1,
-                 prefetch=2, brightness=0.0, contrast=0.0, saturation=0.0):
+                 prefetch=2, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0, pca_noise=0.0, shuffle_chunk_mb=0.0):
         lib = _load()
         if lib is None:
             raise RuntimeError("libmxio.so not available (make -C src)")
@@ -77,12 +82,13 @@ class NativeImageIter:
         mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
         std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
         self._lib = lib
-        self._handle = lib.MXIOImageIterCreate(
+        self._handle = lib.MXIOImageIterCreate2(
             path_imgrec.encode(), batch_size, c, h, w,
             int(preprocess_threads), int(bool(shuffle)), int(seed),
             int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
             float(scale), mean_arr, std_arr, int(label_width), int(prefetch),
-            float(brightness), float(contrast), float(saturation))
+            float(brightness), float(contrast), float(saturation),
+            float(hue), float(pca_noise), float(shuffle_chunk_mb))
         if not self._handle:
             raise RuntimeError(f"native iter failed to open {path_imgrec}")
         self.batch_size = batch_size

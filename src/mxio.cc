@@ -72,7 +72,18 @@ struct IterParams {
   float brightness = 0.f;  // random jitter ranges (0: off)
   float contrast = 0.f;
   float saturation = 0.f;
+  float hue = 0.f;         // max hue shift in OpenCV H units (0-90)
+  float pca_noise = 0.f;   // PCA lighting alpha stddev (image_aug_default.cc)
+  uint64_t shuffle_chunk_bytes = 0;  // 0: full random shuffle
 };
+
+// ImageNet RGB PCA eigen decomposition on the 0-255 scale (reference:
+// src/io/image_aug_default.cc DefaultImageAugmenter pca_noise ~L200,
+// the AlexNet lighting values).
+constexpr float kEigval[3] = {55.46f, 4.794f, 1.148f};
+constexpr float kEigvec[3][3] = {{-0.5675f, 0.7192f, 0.4009f},
+                                 {-0.5808f, -0.0045f, -0.8140f},
+                                 {-0.5836f, -0.6948f, 0.4203f}};
 
 struct Batch {
   std::vector<float> data;
@@ -100,7 +111,37 @@ class ImageRecordIter {
     epoch_++;
     if (p_.shuffle) {
       std::mt19937 rng(p_.seed + epoch_);
-      std::shuffle(order_.begin(), order_.end(), rng);
+      if (p_.shuffle_chunk_bytes == 0) {
+        std::shuffle(order_.begin(), order_.end(), rng);
+      } else {
+        // chunked shuffle (reference: shuffle_chunk_size — bounded-memory
+        // shuffling for .rec files larger than RAM): partition the
+        // SEQUENTIAL record order into byte-bounded chunks, shuffle the
+        // chunk order, then shuffle within each chunk.  Disk reads stay
+        // chunk-local while the stream is still well mixed.
+        for (size_t i = 0; i < order_.size(); ++i) order_[i] = i;
+        std::vector<std::pair<size_t, size_t>> chunks;  // [begin, end)
+        size_t begin = 0;
+        uint64_t acc = 0;
+        for (size_t i = 0; i < order_.size(); ++i) {
+          acc += records_[i].length + 8;
+          if (acc >= p_.shuffle_chunk_bytes || i + 1 == order_.size()) {
+            chunks.emplace_back(begin, i + 1);
+            begin = i + 1;
+            acc = 0;
+          }
+        }
+        std::shuffle(chunks.begin(), chunks.end(), rng);
+        std::vector<size_t> shuffled;
+        shuffled.reserve(order_.size());
+        for (auto& ch : chunks) {
+          size_t lo = shuffled.size();
+          for (size_t i = ch.first; i < ch.second; ++i)
+            shuffled.push_back(i);
+          std::shuffle(shuffled.begin() + lo, shuffled.end(), rng);
+        }
+        order_ = std::move(shuffled);
+      }
     }
     cursor_ = 0;
     done_ = false;
@@ -248,7 +289,7 @@ class ImageRecordIter {
       std::bernoulli_distribution flip(0.5);
       if (flip(rng)) cv::flip(img, img, 1);
     }
-    // color jitter (reference: DefaultImageAugmenter HSL jitter)
+    // color jitter (reference: DefaultImageAugmenter HSL jitter ~L200)
     if (p_.brightness > 0.f || p_.contrast > 0.f) {
       std::uniform_real_distribution<float> db(-p_.brightness, p_.brightness);
       std::uniform_real_distribution<float> dc(-p_.contrast, p_.contrast);
@@ -256,8 +297,43 @@ class ImageRecordIter {
       float beta = 255.f * (p_.brightness > 0 ? db(rng) : 0.f);
       img.convertTo(img, -1, alpha, beta);
     }
+    if (p_.saturation > 0.f) {
+      // blend with per-pixel gray: out = (1+ds)*img - ds*gray
+      std::uniform_real_distribution<float> ds(-p_.saturation, p_.saturation);
+      float d = ds(rng);
+      cv::Mat gray, gray3;
+      cv::cvtColor(img, gray, cv::COLOR_BGR2GRAY);
+      cv::cvtColor(gray, gray3, cv::COLOR_GRAY2BGR);
+      cv::addWeighted(img, 1.f + d, gray3, -d, 0.0, img);
+    }
+    if (p_.hue > 0.f) {
+      std::uniform_real_distribution<float> dh(-p_.hue, p_.hue);
+      int shift = static_cast<int>(dh(rng));
+      if (shift != 0) {
+        cv::Mat hsv;
+        cv::cvtColor(img, hsv, cv::COLOR_BGR2HSV);
+        for (int y = 0; y < hsv.rows; ++y) {
+          unsigned char* row = hsv.ptr<unsigned char>(y);
+          for (int x = 0; x < hsv.cols; ++x) {
+            int h = row[x * 3] + shift;
+            row[x * 3] = static_cast<unsigned char>((h % 180 + 180) % 180);
+          }
+        }
+        cv::cvtColor(hsv, img, cv::COLOR_HSV2BGR);
+      }
+    }
+    // PCA lighting: per-image RGB offset along ImageNet eigenvectors
+    float pca[3] = {0.f, 0.f, 0.f};  // indexed by RGB channel
+    if (p_.pca_noise > 0.f) {
+      std::normal_distribution<float> na(0.f, p_.pca_noise);
+      float a0 = na(rng), a1 = na(rng), a2 = na(rng);
+      for (int c = 0; c < 3; ++c)
+        pca[c] = kEigvec[c][0] * kEigval[0] * a0 +
+                 kEigvec[c][1] * kEigval[1] * a1 +
+                 kEigvec[c][2] * kEigval[2] * a2;
+    }
 
-    // BGR u8 HWC -> RGB f32 CHW with mean/std/scale
+    // BGR u8 HWC -> RGB f32 CHW with lighting/mean/std/scale
     float* dst = batch->data.data() +
                  slot * p_.channels * p_.height * p_.width;
     const int hw = p_.height * p_.width;
@@ -266,7 +342,7 @@ class ImageRecordIter {
       for (int x = 0; x < p_.width; ++x) {
         for (int c = 0; c < p_.channels; ++c) {
           // OpenCV BGR -> RGB channel order
-          float v = static_cast<float>(row[x * 3 + (2 - c)]);
+          float v = static_cast<float>(row[x * 3 + (2 - c)]) + pca[c];
           dst[c * hw + y * p_.width + x] =
               (v - p_.mean[c]) / p_.std_[c] * p_.scale;
         }
@@ -305,12 +381,14 @@ class ImageRecordIter {
 
 extern "C" {
 
-void* MXIOImageIterCreate(const char* rec_path, int batch_size, int channels,
-                          int height, int width, int threads, int shuffle,
-                          unsigned seed, int resize_short, int rand_crop,
-                          int rand_mirror, float scale, const float* mean,
-                          const float* std_, int label_width, int prefetch,
-                          float brightness, float contrast, float saturation) {
+void* MXIOImageIterCreate2(const char* rec_path, int batch_size, int channels,
+                           int height, int width, int threads, int shuffle,
+                           unsigned seed, int resize_short, int rand_crop,
+                           int rand_mirror, float scale, const float* mean,
+                           const float* std_, int label_width, int prefetch,
+                           float brightness, float contrast, float saturation,
+                           float hue, float pca_noise,
+                           float shuffle_chunk_mb) {
   try {
     IterParams p;
     p.batch_size = batch_size;
@@ -333,10 +411,27 @@ void* MXIOImageIterCreate(const char* rec_path, int batch_size, int channels,
     p.brightness = brightness;
     p.contrast = contrast;
     p.saturation = saturation;
+    p.hue = hue;
+    p.pca_noise = pca_noise;
+    p.shuffle_chunk_bytes =
+        static_cast<uint64_t>(shuffle_chunk_mb * (1 << 20));
     return new ImageRecordIter(rec_path, p);
   } catch (...) {
     return nullptr;
   }
+}
+
+void* MXIOImageIterCreate(const char* rec_path, int batch_size, int channels,
+                          int height, int width, int threads, int shuffle,
+                          unsigned seed, int resize_short, int rand_crop,
+                          int rand_mirror, float scale, const float* mean,
+                          const float* std_, int label_width, int prefetch,
+                          float brightness, float contrast, float saturation) {
+  return MXIOImageIterCreate2(rec_path, batch_size, channels, height, width,
+                              threads, shuffle, seed, resize_short, rand_crop,
+                              rand_mirror, scale, mean, std_, label_width,
+                              prefetch, brightness, contrast, saturation,
+                              0.f, 0.f, 0.f);
 }
 
 int MXIOImageIterNext(void* handle, float* data, float* label) {

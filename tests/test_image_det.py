@@ -1,0 +1,182 @@
+"""ImageDetIter + bbox-aware augmentation (reference:
+python/mxnet/image/detection.py; the SSD-512 input path of BASELINE
+config 5) and the new pixel augmenters / native iterator options.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.image.detection import (_parse_det_label, pack_det_label,
+                                       DetHorizontalFlipAug,
+                                       DetRandomCropAug, DetRandomPadAug,
+                                       CreateDetAugmenter, ImageDetIter)
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _make_det_rec(tmp_path, n=12, size=48):
+    """Write a tiny .rec/.idx of synthetic images with det labels."""
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    rng = np.random.RandomState(0)
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, (size, size, 3), np.uint8)
+        objs = np.array([[i % 3, 0.2, 0.3, 0.6, 0.7],
+                         [(i + 1) % 3, 0.1, 0.1, 0.4, 0.5]], np.float32)
+        label = pack_det_label(objs)
+        header = recordio.IRHeader(0, label, i, 0)
+        packed = recordio.pack_img(header, arr, quality=90)
+        writer.write_idx(i, packed)
+    writer.close()
+    return rec_path
+
+
+def test_pack_parse_roundtrip():
+    objs = np.array([[1, 0.1, 0.2, 0.5, 0.6], [2, 0.3, 0.3, 0.9, 0.8]],
+                    np.float32)
+    flat = pack_det_label(objs)
+    back, w = _parse_det_label(flat)
+    assert w == 5
+    np.testing.assert_allclose(back, objs)
+
+
+def test_det_hflip_flips_boxes():
+    import random as pyrandom
+
+    pyrandom.seed(0)
+    aug = DetHorizontalFlipAug(p=1.0)
+    src = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    out, lab = aug(src, label)
+    np.testing.assert_array_equal(out, src[:, ::-1])
+    np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+
+
+def test_det_random_crop_keeps_valid_boxes():
+    import random as pyrandom
+
+    pyrandom.seed(1)
+    aug = DetRandomCropAug(min_object_covered=0.1, area_range=(0.3, 1.0))
+    src = np.zeros((64, 64, 3), np.uint8)
+    label = np.array([[1, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    for _ in range(10):
+        out, lab = aug(src, label)
+        assert lab.shape[1] == 5
+        if lab.shape[0]:
+            assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+            assert (lab[:, 3] >= lab[:, 1]).all()
+
+
+def test_det_random_crop_small_object_coverage():
+    # regression: the accept criterion is object COVERAGE (inter/box area),
+    # not crop-vs-box IoU — a crop containing a tiny box must be accepted
+    import random as pyrandom
+
+    pyrandom.seed(4)
+    aug = DetRandomCropAug(min_object_covered=0.9, area_range=(0.5, 0.9))
+    src = np.zeros((64, 64, 3), np.uint8)
+    label = np.array([[1, 0.48, 0.48, 0.54, 0.54]], np.float32)  # tiny box
+    accepted = 0
+    for _ in range(20):
+        out, lab = aug(src, label)
+        if out.shape[:2] != (64, 64):
+            accepted += 1
+    assert accepted > 0, "crop never accepted despite full tiny-box coverage"
+
+
+def test_det_random_pad_shrinks_boxes():
+    import random as pyrandom
+
+    pyrandom.seed(2)
+    aug = DetRandomPadAug(area_range=(2.0, 2.0))
+    src = np.full((32, 32, 3), 255, np.uint8)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out, lab = aug(src, label)
+    assert out.shape[0] > 32 and out.shape[1] > 32
+    w = lab[0, 3] - lab[0, 1]
+    assert 0.4 < w < 0.9  # 1/sqrt(2) ~ 0.707
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    rec = _make_det_rec(tmp_path)
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32), path_imgrec=rec,
+                      shuffle=True,
+                      aug_list=CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                                  rand_pad=0.5,
+                                                  rand_mirror=True,
+                                                  brightness=0.1))
+    nbatch = 0
+    for batch in it:
+        data = batch.data[0]
+        label = batch.label[0]
+        assert data.shape == (4, 3, 32, 32)
+        assert label.shape[0] == 4 and label.shape[2] == 5
+        lab = label.asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+        nbatch += 1
+    assert nbatch == 3
+
+
+def test_image_det_iter_reshape(tmp_path):
+    rec = _make_det_rec(tmp_path, n=4)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32), path_imgrec=rec,
+                      aug_list=[])
+    it.reshape(data_shape=(3, 24, 24))
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3, 24, 24)
+
+
+def test_pixel_augmenters_shapes_and_ranges():
+    import random as pyrandom
+
+    pyrandom.seed(3)
+    src = np.random.RandomState(3).randint(0, 255, (16, 16, 3),
+                                           np.uint8).astype(np.float32)
+    for aug in (img_mod.BrightnessJitterAug(0.2),
+                img_mod.ContrastJitterAug(0.2),
+                img_mod.SaturationJitterAug(0.2),
+                img_mod.HueJitterAug(0.1),
+                img_mod.LightingAug(0.1, np.array([55.46, 4.794, 1.148]),
+                                    np.random.rand(3, 3)),
+                img_mod.RandomGrayAug(1.0),
+                img_mod.ColorNormalizeAug([123, 116, 103], [58, 57, 57])):
+        out = aug(src)
+        assert out.shape == src.shape, type(aug).__name__
+        assert np.isfinite(np.asarray(out)).all(), type(aug).__name__
+
+
+def test_create_augmenter_includes_color_pipeline():
+    augs = img_mod.CreateAugmenter((3, 16, 16), rand_mirror=True,
+                                   brightness=0.1, contrast=0.1,
+                                   saturation=0.1, hue=0.1, pca_noise=0.05,
+                                   rand_gray=0.05, mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    for expect in ("ColorJitterAug", "HueJitterAug", "LightingAug",
+                   "RandomGrayAug", "ColorNormalizeAug"):
+        assert expect in names, names
+
+
+def test_native_iter_new_augmenters(tmp_path):
+    """hue/pca/chunked-shuffle options reach the C++ pipeline."""
+    from mxnet_tpu.io import ImageRecordIter
+    from mxnet_tpu.io import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip("libmxio.so not built")
+    rec = _make_det_rec(tmp_path, n=16)
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                         batch_size=4, shuffle=True, shuffle_chunk_size=1,
+                         random_h=10, pca_noise=0.05, saturation=0.1,
+                         label_width=1, preprocess_threads=2)
+    count = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert np.isfinite(batch.data[0].asnumpy()).all()
+        count += 1
+    assert count == 4

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark (reference analog:
+benchmark/python + tools/bandwidth — documents the img/s the native
+RecordIO iterator sustains, per SURVEY §7.3 item 4).
+
+Generates a synthetic .rec (random JPEGs at --size), then measures
+ImageRecordIter throughput with the ResNet-50 augmentation recipe.
+Prints one JSON line.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-images", type=int, default=512)
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--threads", type=int, default=os.cpu_count() or 4)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    # the measurement is the HOST decode/augment pipeline — pin jax to CPU
+    # so NDArray wrapping never waits on an accelerator backend
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+    from mxnet_tpu.io import native as native_mod
+
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as d:
+        rec = os.path.join(d, "bench.rec")
+        writer = recordio.MXIndexedRecordIO(os.path.join(d, "bench.idx"),
+                                            rec, "w")
+        for i in range(args.num_images):
+            arr = rng.randint(0, 255, (args.size, args.size, 3), np.uint8)
+            header = recordio.IRHeader(0, float(i % 1000), i, 0)
+            writer.write_idx(i, recordio.pack_img(header, arr, quality=90))
+        writer.close()
+
+        it = ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, args.crop, args.crop),
+            batch_size=args.batch_size, shuffle=True,
+            rand_crop=True, rand_mirror=True, resize=args.size,
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.395, std_g=57.12, std_b=57.375,
+            preprocess_threads=args.threads)
+        # warmup epoch (thread pool spin-up, page cache)
+        for _ in it:
+            pass
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(args.epochs):
+            it.reset()
+            for batch in it:
+                n += batch.data[0].shape[0]
+        dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "image_record_iter_images_per_sec",
+        "value": round(n / dt, 1), "unit": "images/sec",
+        "native": native_mod.available(), "threads": args.threads,
+        "crop": args.crop}))
+
+
+if __name__ == "__main__":
+    main()
