@@ -48,6 +48,7 @@ from . import profiler
 from . import runtime
 from . import util
 from .util import is_np_array
+from . import subgraph
 from . import test_utils
 from . import contrib
 from . import models

@@ -1,3 +1,4 @@
 """Contrib (reference: python/mxnet/contrib/ — amp, quantization, onnx)."""
 from . import amp
 from . import quantization
+from . import onnx

@@ -1,0 +1,39 @@
+"""ONNX interop (reference: python/mxnet/contrib/onnx/ — mx2onnx
+export_model + onnx2mx import, ~5k LoC of per-op translators).
+
+DESCOPE (documented, not silent): this build environment has no `onnx`
+package and zero network egress, so the protobuf schema the translators
+target is unavailable.  The supported interchange paths in this tree are:
+
+  * the symbol-json + params checkpoint (`Symbol.tojson`,
+    `model.save_checkpoint`) — the reference's own native format;
+  * the legacy MXNet 1.x binary .params format (`nd.save_legacy` /
+    `nd.load`) for reference-tooling round-trips;
+  * `gluon.SymbolBlock.imports` for re-loading exported graphs.
+
+If an `onnx` wheel is present at runtime these entry points raise with
+instructions rather than producing wrong models silently.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+_MSG = ("ONNX interop is descoped in the TPU build: the 'onnx' package is "
+        "not available in this environment (zero egress). Use symbol-json "
+        "+ params checkpoints (Symbol.tojson / model.save_checkpoint), the "
+        "legacy binary format (nd.save_legacy), or SymbolBlock.imports. "
+        "See mxnet_tpu/contrib/onnx.py for the rationale.")
+
+
+def export_model(*args, **kwargs):
+    raise MXNetError(_MSG)
+
+
+def import_model(*args, **kwargs):
+    raise MXNetError(_MSG)
+
+
+def get_model_metadata(*args, **kwargs):
+    raise MXNetError(_MSG)

@@ -18,3 +18,4 @@ from . import random_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import cv_ops  # noqa: F401
+from . import quantization  # noqa: F401

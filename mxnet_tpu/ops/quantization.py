@@ -1,0 +1,132 @@
+"""INT8 quantization ops.
+
+Reference parity: src/operator/quantization/ — quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_conv.cc,
+quantized_fully_connected.cc, quantization_utils.h (~15k LoC total; the
+mkldnn int8 kernels' role is played by XLA int8 dot/conv, which lower to
+the MXU with int32 accumulation).
+
+Convention (matches the reference's int8 path): values are quantized
+symmetrically about zero onto [-127, 127] ("shifted" uint8 mode is not
+carried — the reference itself prefers int8 for mkldnn).  Every quantized
+tensor travels with (min_range, max_range) f32 scalars, and
+thresh = max(|min|, |max|), scale = 127 / thresh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _thresh(min_r, max_r):
+    return jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+
+
+@register("_contrib_quantize_v2", differentiable=False)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """f32 -> int8 with calibrated or on-the-fly ranges (reference:
+    quantize_v2.cc).  Returns (q_data, min_range, max_range)."""
+    x = data.astype(jnp.float32)
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range), jnp.float32)
+        mx = jnp.asarray(float(max_calib_range), jnp.float32)
+    else:
+        mn = jnp.min(x)
+        mx = jnp.max(x)
+    t = jnp.maximum(_thresh(mn, mx), 1e-12)
+    scale = 127.0 / t
+    q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+    return q, -t, t
+
+
+@register("_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/int32 -> f32 (reference: dequantize.cc)."""
+    t = jnp.maximum(_thresh(min_range, max_range), 1e-12)
+    if np.dtype(data.dtype) == np.int8:
+        scale = t / 127.0
+    else:  # int32 accumulator: range covers the accumulated magnitude
+        scale = t / float(2**31 - 1)
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 (reference: requantize.cc)."""
+    t_in = jnp.maximum(_thresh(min_range, max_range), 1e-12)
+    real = data.astype(jnp.float32) * (t_in / float(2**31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        t_out = jnp.maximum(
+            _thresh(jnp.asarray(float(min_calib_range), jnp.float32),
+                    jnp.asarray(float(max_calib_range), jnp.float32)), 1e-12)
+    else:
+        t_out = jnp.maximum(jnp.max(jnp.abs(real)), 1e-12)
+    q = jnp.clip(jnp.round(real * (127.0 / t_out)), -127, 127).astype(jnp.int8)
+    return q, -t_out, t_out
+
+
+def _int32_range(t_data, t_weight):
+    """(min,max) of the int32 accumulator in real units: products are
+    bounded by t_data*t_weight scaled to 127*127 (quantization_utils.h
+    kInt32Range bookkeeping)."""
+    t = t_data * t_weight * (float(2**31 - 1) / (127.0 * 127.0))
+    return -t, t
+
+
+@register("_contrib_quantized_fully_connected", differentiable=False)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False,
+                              flatten=True):
+    """int8 x int8 -> int32 FC on the MXU (reference:
+    quantized_fully_connected.cc).  Returns (int32 out, min_out, max_out);
+    bias (f32) is folded in int32 units."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    t_d = _thresh(min_data, max_data)
+    t_w = _thresh(min_weight, max_weight)
+    mn, mx = _int32_range(t_d, t_w)
+    if not no_bias and bias is not None:
+        # bias arrives f32; convert to int32 accumulator units
+        acc_scale = (127.0 * 127.0) / jnp.maximum(t_d * t_w, 1e-12)
+        acc = acc + jnp.round(bias.astype(jnp.float32) * acc_scale
+                              ).astype(jnp.int32)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_conv", differentiable=False)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=(),
+                   stride=(), dilate=(), pad=(), num_filter=1, num_group=1,
+                   no_bias=False, layout=None):
+    """int8 conv with int32 accumulation (reference: quantized_conv.cc)."""
+    n = len(kernel)
+    stride = tuple(stride) if stride else (1,) * n
+    dilate = tuple(dilate) if dilate else (1,) * n
+    pad = tuple(pad) if pad else (0,) * n
+    spatial = "DHW"[-n:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    acc = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    t_d = _thresh(min_data, max_data)
+    t_w = _thresh(min_weight, max_weight)
+    mn, mx = _int32_range(t_d, t_w)
+    if not no_bias and bias is not None:
+        acc_scale = (127.0 * 127.0) / jnp.maximum(t_d * t_w, 1e-12)
+        b = jnp.round(bias.astype(jnp.float32) * acc_scale).astype(jnp.int32)
+        acc = acc + b.reshape((1, -1) + (1,) * n)
+    return acc, mn, mx

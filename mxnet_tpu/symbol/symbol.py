@@ -350,20 +350,42 @@ class Symbol:
             out_structs.append(lst[idx] if idx < len(lst) else None)
         return {"vars": var_struct, "outs": out_structs}
 
+    # -- graph passes ------------------------------------------------------
+    def optimize_for(self, backend: str, args=None, aux=None, **kwargs):
+        """Partition the graph for a subgraph backend (reference:
+        sym.optimize_for / MXOptimizeForBackend over build_subgraph.cc)."""
+        from ..subgraph import partition
+
+        return partition(self, backend)
+
+    def get_backend_symbol(self, backend: str):
+        """1.x-era spelling of optimize_for (reference c_api)."""
+        return self.optimize_for(backend)
+
     # -- binding / evaluation ---------------------------------------------
+    def _env_partitioned(self):
+        """Apply the MXNET_SUBGRAPH_BACKEND env hook before binding
+        (reference: build_subgraph.cc env-dispatch)."""
+        from ..subgraph import env_backend
+
+        be = env_backend()
+        return self.optimize_for(be) if be else self
+
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):
         from .executor import Executor
 
-        return Executor(self, ctx=ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+        return Executor(self._env_partitioned(), ctx=ctx, args=args,
+                        args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux_states)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     **shapes):
         from .executor import Executor
 
-        return Executor._simple_bind(self, ctx=ctx, grad_req=grad_req,
-                                     type_dict=type_dict, shapes=shapes)
+        return Executor._simple_bind(self._env_partitioned(), ctx=ctx,
+                                     grad_req=grad_req, type_dict=type_dict,
+                                     shapes=shapes)
 
     def eval(self, ctx=None, **kwargs):
         from ..ndarray import NDArray
@@ -483,6 +505,11 @@ def _apply_sym(op_name: str, inputs: List[Symbol], attrs: dict,
 def _apply_node(node: _Node, in_vals: list, key, training: bool):
     """Execute one graph node on jax values (used by eval_shape and the
     executor's jitted whole-graph function)."""
+    if node.op == "_subgraph":
+        # a region claimed by a subgraph backend (mxnet_tpu.subgraph):
+        # executes as one callable (jitted per-region under the executor's
+        # outer jit this is a no-op; eagerly it is its own XLA program)
+        return node.attrs["fn"](*in_vals)
     op = _reg.get_op(node.op)
     attrs = dict(node.attrs)
     if node.op == "Dropout":
