@@ -49,6 +49,9 @@ from . import runtime
 from . import util
 from .util import is_np_array
 from . import subgraph
+from . import visualization
+from . import visualization as viz
+from . import checkpoint
 from . import test_utils
 from . import contrib
 from . import models

@@ -45,6 +45,16 @@ def calib_entropy_threshold(arr: np.ndarray, num_bins: int = 2048,
     if amax <= 0:
         return 1e-6
     hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    return _entropy_threshold_from_hist(hist, edges,
+                                        num_quantized_bins=num_quantized_bins)
+
+
+def _entropy_threshold_from_hist(hist: np.ndarray, edges: np.ndarray,
+                                 num_quantized_bins: int = 255) -> float:
+    num_bins = len(hist)
+    amax = float(edges[-1])
+    if amax <= 0:
+        return 1e-6
     hist = hist.astype(np.float64)
     total = hist.sum()
     if total == 0:
@@ -83,11 +93,44 @@ def calib_entropy_threshold(arr: np.ndarray, num_bins: int = 2048,
     return float(best_t)
 
 
+class _StreamingHist:
+    """Fixed-size |x| histogram accumulated incrementally (reference:
+    calibrate.cc keeps a per-layer histogram, never the activations).
+    When a new batch exceeds the current range, existing bins are merged
+    by an integer factor and the width grows — O(num_bins) memory total,
+    vs O(batches x activation size) for buffering samples."""
+
+    def __init__(self, num_bins: int = 2048):
+        self.num_bins = num_bins
+        self.hist = np.zeros(num_bins, np.float64)
+        self.width = None  # bin width; range is [0, num_bins * width)
+
+    def add(self, absarr: np.ndarray) -> None:
+        amax = float(absarr.max()) if absarr.size else 0.0
+        if self.width is None:
+            self.width = max(amax / self.num_bins, 1e-12)
+        limit = self.num_bins * self.width
+        if amax > limit:
+            factor = int(np.ceil(amax / limit))
+            merged = np.zeros(self.num_bins, np.float64)
+            idx = np.arange(self.num_bins) // factor
+            np.add.at(merged, idx, self.hist)
+            self.hist = merged
+            self.width *= factor
+            limit = self.num_bins * self.width
+        h, _ = np.histogram(absarr, bins=self.num_bins, range=(0.0, limit))
+        self.hist += h
+
+    @property
+    def edges(self) -> np.ndarray:
+        return np.arange(self.num_bins + 1) * self.width
+
+
 class _Calibrator:
     def __init__(self, mode: str):
         self.mode = mode
         self.minmax: Dict[str, List[float]] = {}
-        self.samples: Dict[str, List[np.ndarray]] = {}
+        self.hists: Dict[str, _StreamingHist] = {}
 
     def observe(self, name: str, arr) -> None:
         a = np.asarray(arr, np.float32)
@@ -95,14 +138,15 @@ class _Calibrator:
         mm[0] = min(mm[0], float(a.min()))
         mm[1] = max(mm[1], float(a.max()))
         if self.mode == "entropy":
-            self.samples.setdefault(name, []).append(np.abs(a.ravel()))
+            self.hists.setdefault(name, _StreamingHist()).add(
+                np.abs(a.ravel()))
 
     def threshold(self, name: str) -> float:
         if name not in self.minmax:
             raise MXNetError(f"no calibration data observed for {name}")
         if self.mode == "entropy":
-            return calib_entropy_threshold(
-                np.concatenate(self.samples[name]))
+            h = self.hists[name]
+            return _entropy_threshold_from_hist(h.hist, h.edges)
         mn, mx = self.minmax[name]
         return max(abs(mn), abs(mx), 1e-6)
 
@@ -265,6 +309,18 @@ def quantize_net(network, calib_data=None, calib_mode: str = "naive",
                 pp, args[0].asnumpy()))(p)
             layer.register_forward_pre_hook(hook)
             hooks.append((layer, hook))
+        # forward pre-hooks do not fire through the CachedOp fast path —
+        # run calibration eagerly, restoring hybridization afterwards
+        def _active_blocks(block, found):
+            if getattr(block, "_active", False):
+                found.append(block)
+            for child in getattr(block, "_children", {}).values():
+                _active_blocks(child, found)
+            return found
+
+        hybridized = _active_blocks(network, [])
+        for b in hybridized:
+            b._active = False
         try:
             with autograd.pause():
                 for i, batch in enumerate(calib_data):
@@ -276,6 +332,8 @@ def quantize_net(network, calib_data=None, calib_mode: str = "naive",
         finally:
             for layer, hook in hooks:
                 layer._forward_pre_hooks.remove(hook)
+            for b in hybridized:
+                b._active = True
         thresholds = {p: calib.threshold(p) for *_, p in targets}
 
     # build the quantized net: a thin tree mirror whose quantizable leaves

@@ -670,7 +670,15 @@ class Updater:
         state = {}
         for idx, s in self.states.items():
             state[idx] = _states_to_numpy(s)
-        return pickle.dumps((state, self.optimizer) if dump_optimizer else state)
+        # update counts ride along: Adam/LAMB bias correction depends on
+        # the per-index step count, so resume must not reset it (the
+        # reference loses this without dump_optimizer — a documented
+        # resume gap this build closes)
+        payload = {"__states__": state,
+                   "__counts__": dict(self.optimizer._index_update_count),
+                   "__num_update__": self.optimizer.num_update}
+        return pickle.dumps((payload, self.optimizer) if dump_optimizer
+                            else payload)
 
     def set_states(self, states):
         import pickle
@@ -678,9 +686,17 @@ class Updater:
         data = pickle.loads(states)
         if isinstance(data, tuple) and len(data) == 2 and isinstance(
                 data[1], Optimizer):
-            state, self.optimizer = data
+            payload, self.optimizer = data
         else:
-            state = data
+            payload = data
+        if isinstance(payload, dict) and "__states__" in payload:
+            state = payload["__states__"]
+            self.optimizer._index_update_count.update(
+                payload.get("__counts__", {}))
+            self.optimizer.num_update = max(self.optimizer.num_update,
+                                            payload.get("__num_update__", 0))
+        else:  # legacy payload: bare state dict
+            state = payload
         self._numpy_states = state
         for idx, snp in state.items():
             if idx in self.states:

@@ -159,7 +159,10 @@ def _group_nodes(order, prop):
         else:
             group[id(node)] = None
         # groups whose values flow PAST this node while it is not a member
-        poison[id(node)] = p | {g for g in cand if g != my_group}
+        # (compare through find(): ids in cand may have just been merged
+        # into my_group — poisoning those would wall off our own group)
+        mg = find(my_group) if my_group is not None else None
+        poison[id(node)] = p | {g for g in cand if find(g) != mg}
 
     # resolve every node's group to its canonical id
     group = {k: (find(v) if v is not None else None)

@@ -1,0 +1,140 @@
+"""Async step checkpointing (SURVEY §5.3 upgrade over the reference's
+epoch-granularity posture, RNG state included) and visualization
+(reference: python/mxnet/visualization.py print_summary).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, sym
+from mxnet_tpu.checkpoint import AsyncCheckpointer, load_checkpoint_state
+
+
+def _train_setup(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32))
+    return net, trainer, X, Y
+
+
+def _run_steps(net, trainer, X, Y, n, ckpt=None):
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for i in range(n):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(32)
+        losses.append(float(loss.mean().asnumpy()))
+        if ckpt is not None:
+            ckpt.step(net, trainer=trainer, extra={"loss": losses[-1]})
+    return losses
+
+
+def test_async_checkpoint_write_rotate(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=3, keep=2)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    dirs = sorted(d for d in os.listdir(tmp_path) if d.startswith("step-"))
+    assert dirs == ["step-6", "step-9"]  # rotation kept last 2
+    state = load_checkpoint_state(str(tmp_path))
+    assert state["step"] == 9
+    assert "loss" in state["extra"]
+
+
+def test_checkpoint_resume_continues_identically(tmp_path):
+    # run A: 12 steps straight through
+    net_a, tr_a, X, Y = _train_setup(seed=7)
+    losses_a = _run_steps(net_a, tr_a, X, Y, 12)
+
+    # run B: 6 steps, checkpoint, "crash", restore into fresh objects,
+    # 6 more steps — must reproduce run A's tail exactly
+    net_b, tr_b, X2, Y2 = _train_setup(seed=7)
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=6)
+    _run_steps(net_b, tr_b, X2, Y2, 6, ckpt)
+    ckpt.close()
+
+    # fresh process simulation: different seed AND different global name
+    # counters — restore() maps by structural names, so both are fine
+    net_c, tr_c, _, _ = _train_setup(seed=99)
+    from mxnet_tpu import checkpoint as ckpt_mod
+
+    start = ckpt_mod.restore(str(tmp_path), net_c, tr_c)
+    assert start == 6
+    losses_c = _run_steps(net_c, tr_c, X2, Y2, 6)
+    np.testing.assert_allclose(losses_c, losses_a[6:], rtol=1e-5)
+
+
+def test_checkpointer_resumes_step_numbering(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ck1 = AsyncCheckpointer(str(tmp_path), save_every=2, keep=5)
+    _run_steps(net, trainer, X, Y, 4, ck1)
+    ck1.close()
+    # "crash" and restart: new checkpointer continues from step 4
+    ck2 = AsyncCheckpointer(str(tmp_path), save_every=2, keep=5)
+    _run_steps(net, trainer, X, Y, 2, ck2)
+    ck2.close()
+    dirs = sorted(d for d in os.listdir(tmp_path) if d.startswith("step-"))
+    assert "step-6" in dirs, dirs
+    state = load_checkpoint_state(str(tmp_path))
+    assert state["step"] == 6
+
+
+def test_checkpoint_writer_error_surfaces(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path / "sub"), save_every=1)
+    # break the target directory to force a write failure
+    import shutil
+
+    ckpt.wait()
+    shutil.rmtree(str(tmp_path / "sub"))
+    with open(str(tmp_path / "sub"), "w") as f:
+        f.write("not a dir")
+    _run_steps(net, trainer, X, Y, 1, ckpt)
+    with pytest.raises(Exception):
+        ckpt.wait()
+        _run_steps(net, trainer, X, Y, 1, ckpt)
+
+
+# ---------------------------------------------------------------------------
+# visualization
+# ---------------------------------------------------------------------------
+def test_print_summary(capsys):
+    data = sym.Variable("data")
+    h = sym.Convolution(data, name="c1", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = sym.FullyConnected(h, name="fc", num_hidden=10)
+    mx.visualization.print_summary(h, shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "c1 (Convolution)" in out
+    assert "fc (FullyConnected)" in out
+    assert "Total params:" in out
+    # conv: 8*3*3*3 + 8 = 224; fc: 10*(8*4*4) + 10 = 1290
+    assert "1514" in out
+
+
+def test_plot_network_gated():
+    data = sym.Variable("data")
+    out = sym.Activation(sym.FullyConnected(data, name="f", num_hidden=4),
+                         act_type="relu")
+    try:
+        import graphviz  # noqa: F401
+
+        dot = mx.visualization.plot_network(out)
+        assert "f" in dot.source
+    except ImportError:
+        from mxnet_tpu.base import MXNetError
+
+        with pytest.raises(MXNetError, match="graphviz"):
+            mx.visualization.plot_network(out)
