@@ -48,6 +48,7 @@ from . import profiler
 from . import runtime
 from . import util
 from .util import is_np_array
+from . import env_vars
 from . import subgraph
 from . import visualization
 from . import visualization as viz
@@ -61,6 +62,10 @@ from . import models
 # DMLC_PS_ROOT_URI) is present, connect at import time (reference analog:
 # ps::Postoffice::Start, which launch.py's env likewise triggers).
 parallel.dist.init_from_env()
+
+# surface set-but-ineffective MXNET_* env vars in logs (env_vars.describe()
+# has the full disposition table)
+env_vars.check()
 
 
 def waitall():

@@ -76,6 +76,10 @@ class AsyncCheckpointer:
             else:
                 initial_step = 0
         self._step = int(initial_step)
+        # garbage-collect tmp dirs a crashed writer left behind
+        for d in os.listdir(directory):
+            if d.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: Optional[BaseException] = None
         self._writer = threading.Thread(target=self._writer_loop, daemon=True)
@@ -153,7 +157,11 @@ class AsyncCheckpointer:
         step = snap["step"]
         tmp = os.path.join(self.dir, f".tmp-{step}")
         final = os.path.join(self.dir, f"step-{step}")
-        os.makedirs(tmp, exist_ok=True)
+        if os.path.exists(tmp):
+            # leftover from a crashed writer: its stale contents must not
+            # be published into this checkpoint
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
         nd_utils.save(os.path.join(tmp, "params.nd"),
                       {k: nd.array(v, dtype=v.dtype)
                        for k, v in snap["params"].items()})

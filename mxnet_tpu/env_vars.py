@@ -1,0 +1,113 @@
+"""Environment-variable compatibility map (reference: the ~80 documented
+vars of docs/static_site/src/pages/api/faq/env_var.md, read via
+dmlc::GetEnv at use-site; SURVEY §5.6).
+
+Every load-bearing reference variable is listed with its disposition on
+TPU so "is MXNET_X supported?" always has a definite answer:
+
+  honored   — read by this tree at the cited site, same semantics;
+  absorbed  — the responsibility moved into XLA/PjRt/jax; the variable is
+              accepted but has nothing to configure (the jax-level control
+              is named);
+  n/a       — device-specific to CUDA/ROCm hardware, no TPU meaning.
+
+`describe()` returns the table; `check(environ)` warns (once) about set
+MXNET_* variables that are absorbed/n-a so silent expectation mismatches
+surface in logs.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Tuple
+
+__all__ = ["ENV_VARS", "describe", "check"]
+
+# name -> (disposition, detail)
+ENV_VARS: Dict[str, Tuple[str, str]] = {
+    "MXNET_ENGINE_TYPE": (
+        "honored", "NaiveEngine -> synchronous dispatch with per-op "
+        "block_until_ready (ops/registry.py via engine.is_naive)"),
+    "MXNET_USE_FUSION": (
+        "honored", "gates the Pallas fused kernels (ops/pallas enabled())"),
+    "MXNET_SUBGRAPH_BACKEND": (
+        "honored", "partitions symbol graphs at bind time (subgraph.py)"),
+    "MXNET_PROFILER_AUTOSTART": (
+        "honored", "starts the profiler at import (profiler.py)"),
+    "MXNET_SAFE_ACCUMULATION": (
+        "honored", "always-on behavior: fp16 matmul/conv upcast to f32, "
+        "bf16 accumulates f32 natively on the MXU (ops/nn.py _safe_acc); "
+        "setting it to 0 has no effect (accuracy is never degraded)"),
+    "MXNET_TEST_DEVICE": (
+        "honored", "test_utils.default_context device selection"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "absorbed", "whole graphs compile into ONE XLA executable; there "
+        "is no per-segment bulking to tune"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (
+        "absorbed", "same as MXNET_EXEC_BULK_EXEC_TRAIN"),
+    "MXNET_GPU_MEM_POOL_TYPE": (
+        "absorbed", "PjRt owns the device allocator; use "
+        "XLA_PYTHON_CLIENT_MEM_FRACTION / _PREALLOCATE"),
+    "MXNET_GPU_MEM_POOL_RESERVE": (
+        "absorbed", "see MXNET_GPU_MEM_POOL_TYPE"),
+    "MXNET_GPU_WORKER_NTHREADS": (
+        "absorbed", "no per-device worker threads: XLA streams are "
+        "scheduled by PjRt"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "absorbed", "host parallelism: preprocess_threads on the data "
+        "iterators; XLA CPU uses its own thread pool"),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
+        "n/a", "algorithm selection is the XLA compiler's job; no "
+        "cuDNN/MIOpen find-mode on TPU"),
+    "MXNET_KVSTORE_USETREE": (
+        "absorbed", "collective topology is XLA's ICI routing"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "absorbed", "no PS key sharding: gradients allreduce whole over "
+        "DCN (parallel/dist.py)"),
+    "MXNET_ENABLE_GPU_P2P": ("n/a", "ICI is always peer-to-peer"),
+    "MXNET_ENGINE_INFO": (
+        "absorbed", "dependency logging: use JAX_LOG_COMPILES / "
+        "jax.profiler traces"),
+    "OMP_NUM_THREADS": (
+        "honored", "read by XLA:CPU's Eigen pool and OpenCV (libmxio)"),
+    "DMLC_ROLE": ("honored", "launcher contract (tools/launch.py)"),
+    "DMLC_PS_ROOT_URI": (
+        "honored", "rendezvous address (parallel/dist.py init_from_env)"),
+    "DMLC_PS_ROOT_PORT": ("honored", "see DMLC_PS_ROOT_URI"),
+    "DMLC_NUM_WORKER": ("honored", "process count (parallel/dist.py)"),
+    "DMLC_WORKER_ID": ("honored", "process rank (parallel/dist.py)"),
+    "DMLC_NUM_SERVER": (
+        "absorbed", "no parameter-server role in the SPMD design"),
+    "PS_VERBOSE": ("absorbed", "see DMLC_NUM_SERVER"),
+}
+
+_warned = False
+
+
+def describe() -> str:
+    width = max(len(k) for k in ENV_VARS) + 2
+    lines = [f"{'Variable':<{width}}{'Disposition':<12}Detail"]
+    for name, (disp, detail) in sorted(ENV_VARS.items()):
+        lines.append(f"{name:<{width}}{disp:<12}{detail}")
+    return "\n".join(lines)
+
+
+def check(environ=None) -> None:
+    """Log (once) any set MXNET_* variable that has no effect here."""
+    global _warned
+    if _warned:
+        return
+    _warned = True
+    environ = environ if environ is not None else os.environ
+    for name, value in environ.items():
+        if not name.startswith("MXNET_"):
+            continue
+        disp, detail = ENV_VARS.get(name, (None, None))
+        if disp in ("absorbed", "n/a"):
+            logging.getLogger("mxnet_tpu").info(
+                "env var %s=%s has no effect on TPU (%s): %s",
+                name, value, disp, detail)
+        elif disp is None:
+            logging.getLogger("mxnet_tpu").info(
+                "env var %s is not recognized by mxnet_tpu (see "
+                "mxnet_tpu.env_vars.describe())", name)

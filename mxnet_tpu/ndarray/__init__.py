@@ -119,6 +119,20 @@ def shuffle(data, out=None):
     return _reg.invoke_by_name("_shuffle", [key, data], out=out)
 
 
+def cast_storage(data, stype="default", out=None):
+    """Convert between dense and sparse storage (reference:
+    src/operator/tensor/cast_storage.cc).  Thin op-name facade over
+    NDArray.tostype — the single conversion implementation."""
+    res = data.tostype(stype)
+    if res is data:  # tostype may return self; the op semantics copy
+        res = data.copy()
+    if out is not None:
+        out._set_data(res._data if stype == "default"
+                      else res.todense()._data)
+        return out
+    return res
+
+
 _SPECIAL = {"Dropout": Dropout, "BatchNorm": BatchNorm, "_shuffle": shuffle}
 _SKIP_PREFIXES = ("_random_", "_sample_", "sample_")
 

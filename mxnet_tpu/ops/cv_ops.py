@@ -564,3 +564,69 @@ def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
                              clip if clip > 0 else None)
 
     return jax.vmap(one)(data)
+
+
+@register("_contrib_mrcnn_mask_target", differentiable=False)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, num_rois=None,
+                      num_classes=1, mask_size=(14, 14), sample_ratio=2,
+                      aligned=False):
+    """Mask-RCNN training targets (reference: src/operator/contrib/
+    mrcnn_mask_target.cu): crop each matched ground-truth mask to its ROI
+    with bilinear ROIAlign sampling and emit per-class targets + weights.
+
+    rois: (B, N, 4) [x1,y1,x2,y2] in image coords; gt_masks: (B, M, H, W)
+    {0,1}; matches: (B, N) gt index per roi; cls_targets: (B, N) class id
+    (0 = background).  Returns (mask_targets (B,N,C,ms,ms),
+    mask_weights (B,N,C,ms,ms)) where weights one-hot the matched class.
+    """
+    if isinstance(mask_size, int):
+        mask_size = (mask_size, mask_size)
+    ms_h, ms_w = mask_size
+    b, n, _ = rois.shape
+    _, m, h, w = gt_masks.shape
+    ratio = int(sample_ratio) if sample_ratio and sample_ratio > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    def one(roi, mask):
+        # mask: (H, W) float; roi [x1,y1,x2,y2]
+        x1, y1, x2, y2 = (roi[0] - offset, roi[1] - offset,
+                          roi[2] - offset, roi[3] - offset)
+        bin_w = jnp.maximum(x2 - x1, 1.0) / ms_w
+        bin_h = jnp.maximum(y2 - y1, 1.0) / ms_h
+        gy = y1 + (jnp.arange(ms_h * ratio, dtype=jnp.float32) + 0.5) * (
+            bin_h / ratio)
+        gx = x1 + (jnp.arange(ms_w * ratio, dtype=jnp.float32) + 0.5) * (
+            bin_w / ratio)
+
+        def bilinear(y, x):
+            y = jnp.clip(y, 0.0, h - 1.0)
+            x = jnp.clip(x, 0.0, w - 1.0)
+            y0 = jnp.floor(y).astype(jnp.int32)
+            x0 = jnp.floor(x).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            wy, wx = y - y0, x - x0
+            return (mask[y0, x0] * (1 - wy) * (1 - wx)
+                    + mask[y0, x1i] * (1 - wy) * wx
+                    + mask[y1i, x0] * wy * (1 - wx)
+                    + mask[y1i, x1i] * wy * wx)
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        samples = jax.vmap(jax.vmap(bilinear))(yy, xx)
+        return samples.reshape(ms_h, ratio, ms_w, ratio).mean(axis=(1, 3))
+
+    def per_image(rois_i, masks_i, match_i):
+        matched = masks_i[jnp.clip(match_i.astype(jnp.int32), 0, m - 1)]
+        return jax.vmap(one)(rois_i, matched.astype(jnp.float32))
+
+    targets = jax.vmap(per_image)(rois.astype(jnp.float32),
+                                  gt_masks, matches)  # (B, N, ms, ms)
+    cls = jnp.clip(cls_targets.astype(jnp.int32), 0, num_classes - 1)
+    onehot = jax.nn.one_hot(cls, num_classes, dtype=targets.dtype)
+    # weights zero for background (cls_target 0)
+    onehot = onehot * (cls_targets > 0)[..., None].astype(targets.dtype)
+    mask_targets = targets[:, :, None] * onehot[..., None, None]
+    mask_weights = jnp.broadcast_to(
+        onehot[..., None, None],
+        (b, n, num_classes, ms_h, ms_w)).astype(targets.dtype)
+    return mask_targets, mask_weights

@@ -301,3 +301,74 @@ def size_array(x):
 @register("zeros_like_legacy", differentiable=False)
 def zeros_like_legacy(x):
     return jnp.zeros_like(x)
+
+
+# ---------------------------------------------------------------------------
+# op tail (r3): batch_take, khatri_rao, linalg extras
+# (reference: src/operator/tensor/indexing_op.cc batch_take, khatri_rao.cc,
+# la_op.cc sumlogdiag/extractdiag/makediag/gelqf/inverse/det)
+# ---------------------------------------------------------------------------
+@register("batch_take")
+def batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (reference: batch_take)."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    rows = jnp.arange(a.shape[0])
+    return a[rows, jnp.clip(idx, 0, a.shape[1] - 1)]
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference: khatri_rao.cc)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(a, offset=0):
+    """np.diag semantics: (..., n) values -> (..., n+|k|, n+|k|) matrix
+    with the values on diagonal k."""
+    import numpy as np
+
+    n = a.shape[-1]
+    m = n + abs(int(offset))
+    rows = np.arange(n) + max(-int(offset), 0)
+    cols = np.arange(n) + max(int(offset), 0)
+    out = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("linalg_inverse")
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det")
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet")
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("linalg_gelqf", differentiable=False)
+def linalg_gelqf(a):
+    """LQ factorization A = L Q with Q orthonormal rows (reference:
+    la_op gelqf via LAPACK)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)

@@ -16,13 +16,16 @@ from .base import MXNetError
 __all__ = ["print_summary", "plot_network"]
 
 
-def _param_count(node, shapes: Dict[str, tuple]) -> int:
+def _param_count(node, shapes: Dict[str, tuple], input_names) -> int:
+    """Trainable-parameter count: variable inputs that are neither
+    network INPUTS (anything the user listed in `shape` — data, rois,
+    im_info, ...) nor aux/label state."""
     total = 0
     for parent, _ in node.inputs:
         if parent.is_variable() and not parent.name.endswith(
                 ("_moving_mean", "_moving_var", "label")):
             shp = shapes.get(parent.name)
-            if shp and parent.name != "data":
+            if shp and parent.name not in input_names:
                 total += int(np.prod(shp))
     return total
 
@@ -37,6 +40,7 @@ def print_summary(symbol, shape: Optional[dict] = None, line_length: int = 98,
 
     shapes: Dict[str, tuple] = {}
     out_shapes: Dict[int, tuple] = {}
+    input_names = set(shape) if shape is not None else {"data"}
     if shape is not None:
         arg_shapes, out_s, aux_shapes = symbol.infer_shape(**shape)
         for name, s in zip(symbol.list_arguments(), arg_shapes):
@@ -68,7 +72,7 @@ def print_summary(symbol, shape: Optional[dict] = None, line_length: int = 98,
     for node in order:
         if node.is_variable():
             continue
-        params = _param_count(node, shapes)
+        params = _param_count(node, shapes, input_names)
         total_params += params
         prev = ",".join(p.name for p, _ in node.inputs
                         if not p.is_variable())[:30]

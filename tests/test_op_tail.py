@@ -1,0 +1,124 @@
+"""Op-tail additions (r3): batch_take, khatri_rao, linalg extras,
+cast_storage, mrcnn_mask_target, env-var map.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_batch_take():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([0, 2, 1, 0], np.float32))
+    out = nd.batch_take(a, idx).asnumpy()
+    np.testing.assert_array_equal(out, [0, 5, 7, 9])
+
+
+def test_khatri_rao():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([[5.0, 6.0], [7.0, 8.0]], np.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    expect = np.stack([np.kron(a[:, 0], b[:, 0]),
+                       np.kron(a[:, 1], b[:, 1])], axis=1)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_linalg_extras():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    inv = nd.linalg_inverse(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+    det = float(nd.linalg_det(nd.array(a)).asnumpy())
+    np.testing.assert_allclose(det, np.linalg.det(a), rtol=1e-4)
+    sign, logdet = nd.linalg_slogdet(nd.array(a))
+    np.testing.assert_allclose(float(sign.asnumpy())
+                               * np.exp(float(logdet.asnumpy())),
+                               np.linalg.det(a), rtol=1e-4)
+    tri = np.tril(a)
+    sld = float(nd.linalg_sumlogdiag(nd.array(tri)).asnumpy())
+    np.testing.assert_allclose(sld, np.log(np.diag(tri)).sum(), rtol=1e-5)
+    d = nd.linalg_extractdiag(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(d, np.diag(a))
+    # LQ: A = L @ Q, Q Q^T = I
+    l_, q = nd.linalg_gelqf(nd.array(a))
+    np.testing.assert_allclose(l_.asnumpy() @ q.asnumpy(), a, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(3),
+                               atol=1e-5)
+
+
+def test_linalg_makediag_offsets():
+    # regression (review): nonzero offsets must give the square np.diag
+    # result, not a wrapped (n, n+|k|) matrix
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    for k in (-2, -1, 0, 1, 2):
+        out = nd.linalg_makediag(nd.array(v), offset=k).asnumpy()
+        np.testing.assert_array_equal(out, np.diag(v, k), err_msg=f"k={k}")
+
+
+def test_print_summary_multi_input(capsys):
+    # regression (review): auxiliary INPUTS (rois etc.) are not parameters
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    feat = sym.Convolution(data, name="cmi", kernel=(1, 1), num_filter=2)
+    pooled = sym.contrib.ROIAlign(feat, rois, pooled_size=(2, 2),
+                                  spatial_scale=1.0)
+    mx.viz.print_summary(pooled, shape={"data": (1, 3, 8, 8),
+                                        "rois": (4, 5)})
+    out = capsys.readouterr().out
+    # conv: 2*3*1*1 + 2 = 8; rois' 20 elements must NOT be counted
+    assert "Total params: 8" in out, out
+
+
+def test_cast_storage_roundtrip():
+    a = np.zeros((5, 3), np.float32)
+    a[1] = [1, 2, 3]
+    a[4] = [4, 5, 6]
+    rs = nd.cast_storage(nd.array(a), stype="row_sparse")
+    assert rs.stype == "row_sparse"
+    np.testing.assert_array_equal(sorted(rs.indices.asnumpy().tolist()),
+                                  [1, 4])
+    back = nd.cast_storage(rs, stype="default")
+    np.testing.assert_array_equal(back.asnumpy(), a)
+    csr = nd.cast_storage(nd.array(a), stype="csr")
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), a)
+
+
+def test_mrcnn_mask_target_shapes_and_crop():
+    B, N, M, H, W = 1, 2, 2, 16, 16
+    rois = np.array([[[0, 0, 8, 8], [8, 8, 16, 16]]], np.float32)
+    gt = np.zeros((B, M, H, W), np.float32)
+    gt[0, 0, :8, :8] = 1.0     # mask 0 fills the first roi exactly
+    gt[0, 1, 12:, 12:] = 1.0   # mask 1 fills a corner of the second
+    matches = np.array([[0, 1]], np.float32)
+    cls = np.array([[1, 2]], np.float32)
+    targets, weights = nd.contrib.mrcnn_mask_target(
+        nd.array(rois), nd.array(gt), nd.array(matches), nd.array(cls),
+        num_rois=N, num_classes=3, mask_size=(8, 8))
+    t = targets.asnumpy()
+    wgt = weights.asnumpy()
+    assert t.shape == (B, N, 3, 8, 8) and wgt.shape == t.shape
+    # roi 0 / class 1: mask fully covers -> interior ~1
+    assert t[0, 0, 1, 2:6, 2:6].min() > 0.9
+    # weights one-hot the matched class
+    assert wgt[0, 0, 1].min() == 1.0 and wgt[0, 0, 2].max() == 0.0
+    assert wgt[0, 1, 2].min() == 1.0 and wgt[0, 1, 1].max() == 0.0
+
+
+def test_env_vars_map():
+    from mxnet_tpu import env_vars
+
+    table = env_vars.describe()
+    assert "MXNET_ENGINE_TYPE" in table
+    assert "MXNET_SAFE_ACCUMULATION" in table
+    # every entry has a known disposition
+    for name, (disp, detail) in env_vars.ENV_VARS.items():
+        assert disp in ("honored", "absorbed", "n/a"), name
+        assert detail
+    env_vars._warned = False
+    env_vars.check({"MXNET_GPU_MEM_POOL_TYPE": "Round",
+                    "MXNET_MYSTERY_FLAG": "1"})
