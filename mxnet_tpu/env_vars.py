@@ -40,6 +40,9 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "setting it to 0 has no effect (accuracy is never degraded)"),
     "MXNET_TEST_DEVICE": (
         "honored", "test_utils.default_context device selection"),
+    "MXNET_USE_NATIVE_IO": (
+        "honored", "0 disables the libmxio C++ decode/augment pipeline and "
+        "falls back to the python iterator (io/native.py)"),
     "MXNET_EXEC_BULK_EXEC_TRAIN": (
         "absorbed", "whole graphs compile into ONE XLA executable; there "
         "is no per-segment bulking to tune"),

@@ -125,10 +125,16 @@ def cast_storage(data, stype="default", out=None):
     NDArray.tostype — the single conversion implementation."""
     res = data.tostype(stype)
     if res is data:  # tostype may return self; the op semantics copy
-        res = data.copy()
+        res = data.copyto(data.context)
     if out is not None:
-        out._set_data(res._data if stype == "default"
-                      else res.todense()._data)
+        if out.stype != stype:
+            raise MXNetError(
+                f"cast_storage: out has stype {out.stype!r}, "
+                f"expected {stype!r}")
+        out._set_data(res._data)
+        if stype != "default":
+            out._aux = dict(res._aux)
+            out._shape = res._shape
         return out
     return res
 

@@ -340,8 +340,6 @@ def linalg_extractdiag(a, offset=0):
 def linalg_makediag(a, offset=0):
     """np.diag semantics: (..., n) values -> (..., n+|k|, n+|k|) matrix
     with the values on diagonal k."""
-    import numpy as np
-
     n = a.shape[-1]
     m = n + abs(int(offset))
     rows = np.arange(n) + max(-int(offset), 0)
@@ -368,7 +366,8 @@ def linalg_slogdet(a):
 
 @register("linalg_gelqf", differentiable=False)
 def linalg_gelqf(a):
-    """LQ factorization A = L Q with Q orthonormal rows (reference:
-    la_op gelqf via LAPACK)."""
+    """LQ factorization A = L Q with Q orthonormal rows, returned as
+    (Q, L) matching the reference calling convention `Q, L = gelqf(A)`
+    (reference: la_op gelqf via LAPACK)."""
     q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
-    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)

@@ -40,8 +40,8 @@ def test_linalg_extras():
     np.testing.assert_allclose(sld, np.log(np.diag(tri)).sum(), rtol=1e-5)
     d = nd.linalg_extractdiag(nd.array(a)).asnumpy()
     np.testing.assert_allclose(d, np.diag(a))
-    # LQ: A = L @ Q, Q Q^T = I
-    l_, q = nd.linalg_gelqf(nd.array(a))
+    # LQ: A = L @ Q, Q Q^T = I; reference convention returns (Q, L)
+    q, l_ = nd.linalg_gelqf(nd.array(a))
     np.testing.assert_allclose(l_.asnumpy() @ q.asnumpy(), a, rtol=1e-4,
                                atol=1e-5)
     np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(3),
@@ -86,6 +86,29 @@ def test_cast_storage_roundtrip():
     csr = nd.cast_storage(nd.array(a), stype="csr")
     assert csr.stype == "csr"
     np.testing.assert_array_equal(csr.asnumpy(), a)
+
+
+def test_cast_storage_same_stype_copies():
+    a = np.zeros((5, 3), np.float32)
+    a[1] = [1, 2, 3]
+    rs = nd.array(a).tostype("row_sparse")
+    rs2 = nd.cast_storage(rs, stype="row_sparse")
+    assert rs2 is not rs
+    assert rs2.stype == "row_sparse"
+    assert rs2.shape == (5, 3)
+    np.testing.assert_array_equal(rs2.asnumpy(), a)
+
+
+def test_cast_storage_out_sparse():
+    a = np.zeros((4, 2), np.float32)
+    a[2] = [7, 8]
+    dst = nd.zeros((4, 2)).tostype("row_sparse")
+    out = nd.cast_storage(nd.array(a), stype="row_sparse", out=dst)
+    assert out is dst
+    np.testing.assert_array_equal(out.asnumpy(), a)
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2])
+    with pytest.raises(mx.base.MXNetError):
+        nd.cast_storage(nd.array(a), stype="csr", out=dst)
 
 
 def test_mrcnn_mask_target_shapes_and_crop():
