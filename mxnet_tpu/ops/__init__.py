@@ -19,3 +19,4 @@ from . import rnn_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import cv_ops  # noqa: F401
 from . import quantization  # noqa: F401
+from . import warp_ops  # noqa: F401

@@ -217,3 +217,41 @@ def smooth_l1(x, scalar=1.0):
     return jnp.where(
         jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x), jnp.abs(x) - 0.5 / s2
     )
+
+
+@register("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output=True):
+    """1.0 when every element is finite, else 0.0 (reference
+    src/operator/contrib/all_finite.cc) — the AMP overflow check.
+
+    The reference's init_output=False AND-accumulates into the existing
+    output buffer (chunked checks); this op layer is functional, so that
+    mode is rejected rather than silently overwriting — pass all chunks
+    to multi_all_finite instead.
+    """
+    if not init_output:
+        from ..base import MXNetError
+
+        raise MXNetError("all_finite: init_output=False (accumulate into "
+                         "out) is not supported; check all arrays in one "
+                         "multi_all_finite call instead")
+    return jnp.isfinite(data.astype(jnp.float32)).all().astype(
+        jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    if not init_output:
+        from ..base import MXNetError
+
+        raise MXNetError("multi_all_finite: init_output=False is not "
+                         "supported; pass all arrays in one call")
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a.astype(jnp.float32)).all()
+    return ok.astype(jnp.float32).reshape(1)

@@ -134,3 +134,15 @@ def _ravel_multi_index(indices, shape=()):
 def _unravel_index(indices, shape=()):
     out = jnp.stack(jnp.unravel_index(indices.astype(jnp.int64), shape))
     return out.astype(jnp.int64)
+
+
+# public aliases (reference python/mxnet/ndarray/ndarray.py exposes these
+# without the leading underscore)
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(indices, shape=()):
+    return _ravel_multi_index(indices, shape=shape)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(indices, shape=()):
+    return _unravel_index(indices, shape=shape)

@@ -641,3 +641,206 @@ def ctc_loss(data, label, *lengths, use_data_lengths=False,
     ll = jax.vmap(_ctc_forward, in_axes=(1, 0, 0, 0, 0))(
         logp, t_lens, ext, s_valid, skip_ok)
     return (-ll).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# legacy spatial utility ops (reference src/operator/pad.cc, crop.cc,
+# nn/im2col.h, nn/moments.cc, svm_output.cc)
+# ---------------------------------------------------------------------------
+@register("Pad")
+def pad_op(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad (reference src/operator/pad.cc): pad_width is the flat
+    (before, after) pair per axis, mxnet convention."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(data.ndim)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=jnp.asarray(
+            constant_value, data.dtype))
+    return jnp.pad(data, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("Crop")
+def crop_op(*inputs, offset=(0, 0), h_w=(0, 0), num_args=1,
+            center_crop=False):
+    """Crop data (B,C,H,W) to h_w, or to the spatial size of a second
+    reference input (reference src/operator/crop.cc)."""
+    data = inputs[0]
+    if num_args == 2 and len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("moments")
+def moments(data, axes=None, keepdims=False):
+    """Mean and variance over axes (reference src/operator/nn/moments.cc)."""
+    ax = tuple(int(a) for a in axes) if axes is not None else None
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=ax, keepdims=keepdims)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=ax)
+    return mean.astype(data.dtype), var.astype(data.dtype)
+
+
+_svm_output_cache = {}
+
+
+def _make_svm_output(margin, reg_coef, use_linear):
+    """Legacy output-op semantics like SoftmaxOutput: forward is identity,
+    backward ignores the cotangent and emits the hinge-loss gradient
+    (reference src/operator/svm_output-inl.h)."""
+
+    @jax.custom_vjp
+    def fwd(data, label):
+        return data
+
+    def f(data, label):
+        return data, (data, label)
+
+    def b(res, g):
+        data, label = res
+        x32 = data.astype(jnp.float32)
+        k = data.shape[-1]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, k, dtype=jnp.float32)
+        scores_y = jnp.sum(x32 * onehot, axis=-1, keepdims=True)
+        viol = margin - scores_y + x32  # (..., k); at y: margin exactly
+        if use_linear:  # L1-SVM: +-reg on violating classes
+            mask = ((viol > 0) & (onehot == 0)).astype(jnp.float32)
+            grad = reg_coef * mask
+        else:  # L2-SVM: gradient proportional to the violation
+            mask = ((viol > 0) & (onehot == 0)).astype(jnp.float32)
+            grad = 2.0 * reg_coef * viol * mask
+        grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
+        if is_float_dtype(label.dtype):
+            lab_ct = jnp.zeros_like(label)
+        else:
+            lab_ct = np.zeros(label.shape, dtype=jax.dtypes.float0)
+        return (grad.astype(data.dtype), lab_ct)
+
+    fwd.defvjp(f, b)
+    return fwd
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    key = (float(margin), float(regularization_coefficient), bool(use_linear))
+    fn = _svm_output_cache.get(key)
+    if fn is None:
+        fn = _make_svm_output(*key)
+        _svm_output_cache[key] = fn
+    return fn(data, label)
+
+
+@register("im2col")
+def im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """Sliding-window unfold: (B,C,*sp) -> (B, C*prod(kernel), L)
+    (reference src/operator/nn/im2col.h).  Feature order is channel-major
+    then kernel-position, matching the reference."""
+    n = len(kernel)
+    stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
+    pad = _pair(pad or 0, n)
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(int(k) for k in kernel),
+        window_strides=tuple(int(s) for s in stride),
+        padding=[(int(p), int(p)) for p in pad],
+        rhs_dilation=tuple(int(d) for d in dilate))
+    B = data.shape[0]
+    return patches.reshape(B, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, output_size=(), kernel=(), stride=(), dilate=(), pad=()):
+    """Inverse of im2col: overlapping patches scatter-add back into
+    (B, C, *output_size) (reference src/operator/nn/im2col.h col2im)."""
+    n = len(kernel)
+    stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
+    pad = _pair(pad or 0, n)
+    kernel = tuple(int(k) for k in kernel)
+    out_sp = tuple(int(s) for s in output_size)
+    B = data.shape[0]
+    C = data.shape[1] // int(np.prod(kernel))
+    padded_sp = tuple(out_sp[i] + 2 * int(pad[i]) for i in range(n))
+    o_sp = tuple(
+        (padded_sp[i] - (dilate[i] * (kernel[i] - 1) + 1)) // stride[i] + 1
+        for i in range(n))
+    cols = data.reshape((B, C) + kernel + o_sp)
+    out = jnp.zeros((B, C) + padded_sp, jnp.float32)
+    for kidx in np.ndindex(*kernel):
+        sl = tuple(
+            slice(kidx[i] * dilate[i],
+                  kidx[i] * dilate[i] + o_sp[i] * stride[i], stride[i])
+            for i in range(n))
+        out = out.at[(slice(None), slice(None)) + sl].add(
+            cols[(slice(None), slice(None)) + kidx].astype(jnp.float32))
+    crop = tuple(slice(int(pad[i]), int(pad[i]) + out_sp[i])
+                 for i in range(n))
+    return out[(slice(None), slice(None)) + crop].astype(data.dtype)
+
+
+@register("RNN")
+def rnn_op(data, parameters, state, *state_cell, state_size=0, num_layers=1,
+           bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
+           projection_size=None, use_sequence_length=False, lstm_state_clip_min=None,
+           lstm_state_clip_max=None, lstm_state_clip_nan=False):
+    """Fused RNN with the reference's packed flat parameter vector
+    (reference src/operator/rnn.cc: weights layer-major i2h/h2h first,
+    then all biases — the cuDNN/MIOpen packing).  Unpacks the vector and
+    delegates to rnn_ops._fused_rnn.  Dropout between layers is
+    inference-ignored here (the stateless op has no RNG key input);
+    gluon.rnn layers use _fused_rnn with an explicit key for training.
+    """
+    from .rnn_ops import _fused_rnn
+
+    if use_sequence_length:
+        raise MXNetError("RNN: use_sequence_length is not supported; mask "
+                         "outputs with SequenceMask instead")
+    if (lstm_state_clip_min is not None or lstm_state_clip_max is not None
+            or projection_size is not None):
+        raise MXNetError("RNN: lstm_state_clip_* / projection_size are not "
+                         "supported")
+
+    gates = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
+    H = int(state_size)
+    dirs = 2 if bidirectional else 1
+    I = data.shape[2]
+    flat = parameters
+    # weights first (i2h then h2h per layer/direction), then all biases
+    w_slices, b_slices = [], []
+    off = 0
+    for layer in range(num_layers):
+        inp = I if layer == 0 else H * dirs
+        for _ in range(dirs):
+            w_slices.append((off, (gates * H, inp))); off += gates * H * inp
+            w_slices.append((off, (gates * H, H))); off += gates * H * H
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            b_slices.append((off, (gates * H,))); off += gates * H
+            b_slices.append((off, (gates * H,))); off += gates * H
+
+    def take(spec):
+        o, shp = spec
+        return jax.lax.dynamic_slice_in_dim(
+            flat, o, int(np.prod(shp))).reshape(shp)
+
+    weights = []
+    for s in range(num_layers * dirs):
+        weights.extend([take(w_slices[2 * s]), take(w_slices[2 * s + 1]),
+                        take(b_slices[2 * s]), take(b_slices[2 * s + 1])])
+    cell = state_cell[0] if mode == "lstm" else jnp.zeros_like(state)
+    outs = _fused_rnn(data, None, state, cell, *weights, mode=mode,
+                      state_size=H, num_layers=num_layers,
+                      bidirectional=bidirectional, p=0.0, training=False)
+    if not state_outputs:
+        return outs[0] if isinstance(outs, tuple) else outs
+    return outs

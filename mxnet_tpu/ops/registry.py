@@ -169,8 +169,20 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
             and any(_is_float(a) for a in arrays)
         ):
             outs, vjp_fn = _vjp(_wrap_detached(jfn, inputs), arrays)
-            out_list = outs if isinstance(outs, (tuple, list)) else [outs]
-            autograd.record_node(vjp_fn, arrays, list(out_list), input_nds=inputs)
+            seq = isinstance(outs, (tuple, list))
+            out_list = list(outs) if seq else [outs]
+            # identity-like ops (e.g. SVMOutput's forward) can return an
+            # INPUT array object unchanged; the tape keys nodes by
+            # id(array), so an aliased output would both seed the head
+            # cotangent and receive the op's vjp — break the alias
+            in_ids = {id(a) for a in arrays}
+            if any(id(o) in in_ids for o in out_list):
+                import jax.numpy as jnp
+
+                out_list = [jnp.copy(o) if id(o) in in_ids else o
+                            for o in out_list]
+                outs = type(outs)(out_list) if seq else out_list[0]
+            autograd.record_node(vjp_fn, arrays, out_list, input_nds=inputs)
         else:
             outs = jfn(*arrays)
         if engine.is_naive():

@@ -1,0 +1,240 @@
+"""Warp/sampling ops + legacy op-tail additions: GridGenerator,
+BilinearSampler, SpatialTransformer, Correlation, Pad, Crop, moments,
+SVMOutput, im2col/col2im, RNN (flat-parameter facade), all_finite,
+digamma, ravel/unravel aliases.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_grid_generator_affine_identity():
+    B, H, W = 2, 4, 5
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (B, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(H, W)).asnumpy()
+    assert grid.shape == (B, 2, H, W)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, W), atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, H),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid():
+    B, C, H, W = 1, 2, 5, 6
+    data = np.random.rand(B, C, H, W).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (B, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(H, W))
+    out = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_shift_zero_pad():
+    # grid entirely outside the image -> zeros
+    data = np.ones((1, 1, 4, 4), np.float32)
+    grid = np.full((1, 2, 4, 4), 5.0, np.float32)
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
+def test_spatial_transformer_identity():
+    B, C, H, W = 2, 3, 6, 6
+    data = np.random.rand(B, C, H, W).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (B, 1))
+    out = nd.SpatialTransformer(nd.array(data), nd.array(loc),
+                                target_shape=(H, W)).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_grad_flows():
+    data = nd.array(np.random.rand(1, 1, 4, 4).astype(np.float32))
+    loc = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32))
+    data.attach_grad()
+    loc.attach_grad()
+    with autograd.record():
+        out = nd.SpatialTransformer(data, loc, target_shape=(4, 4))
+        s = out.sum()
+    s.backward()
+    assert np.isfinite(data.grad.asnumpy()).all()
+    assert np.isfinite(loc.grad.asnumpy()).all()
+
+
+def test_correlation_self_identity():
+    # zero displacement channel of Correlation(x, x) is mean(x^2, C)
+    B, C, H, W = 1, 3, 6, 6
+    x = np.random.rand(B, C, H, W).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1, is_multiply=True).asnumpy()
+    D = 3
+    assert out.shape == (B, D * D, H, W)
+    center = out[:, (D * D) // 2]
+    np.testing.assert_allclose(center, (x * x).mean(axis=1), rtol=1e-5)
+
+
+def test_correlation_displacement():
+    # data2 = data1 shifted right by 1: the (dy=0,dx=1) channel matches
+    B, C, H, W = 1, 2, 5, 5
+    x = np.random.rand(B, C, H, W).astype(np.float32)
+    x2 = np.zeros_like(x)
+    x2[:, :, :, 1:] = x[:, :, :, :-1]
+    out = nd.Correlation(nd.array(x), nd.array(x2), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1, is_multiply=True).asnumpy()
+    # displacement-major: (dy,dx) row-major over 3x3, (0,+1) is index 5
+    chan = out[0, 5]
+    expect = (x * x).mean(axis=1)[0]
+    np.testing.assert_allclose(chan[:, :-1], expect[:, :-1], rtol=1e-4)
+
+
+def test_correlation_kernel3_mean_of_products():
+    """kernel_size>1 must average the per-pixel products over the patch
+    (mean of products), not multiply patch means."""
+    B, C, H, W = 1, 2, 9, 9
+    rng = np.random.RandomState(0)
+    x1 = rng.rand(B, C, H, W).astype(np.float32)
+    x2 = rng.rand(B, C, H, W).astype(np.float32)
+    k, md = 3, 1
+    out = nd.Correlation(nd.array(x1), nd.array(x2), kernel_size=k,
+                         max_displacement=md, stride1=1, stride2=1,
+                         pad_size=0, is_multiply=True).asnumpy()
+    D = 2 * md + 1
+    border = k // 2 + md
+    Ho = H - 2 * border
+    # zero-displacement channel at output origin: mean over C and the 3x3
+    # patch centred at (border, border) of x1*x2
+    patch1 = x1[0, :, border - 1:border + 2, border - 1:border + 2]
+    patch2 = x2[0, :, border - 1:border + 2, border - 1:border + 2]
+    expect = (patch1 * patch2).mean()
+    np.testing.assert_allclose(out[0, (D * D) // 2, 0, 0], expect, rtol=1e-5)
+    assert out.shape[2] == Ho
+
+
+def test_pad_modes():
+    x = np.arange(12, dtype=np.float32).reshape(1, 1, 3, 4)
+    pw = (0, 0, 0, 0, 1, 1, 2, 2)
+    out = nd.Pad(nd.array(x), mode="constant", pad_width=pw,
+                 constant_value=7).asnumpy()
+    np.testing.assert_allclose(
+        out, np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)),
+                    constant_values=7))
+    out = nd.Pad(nd.array(x), mode="edge", pad_width=pw).asnumpy()
+    np.testing.assert_allclose(
+        out, np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="edge"))
+    out = nd.Pad(nd.array(x), mode="reflect", pad_width=pw).asnumpy()
+    np.testing.assert_allclose(
+        out, np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="reflect"))
+
+
+def test_crop():
+    x = np.random.rand(1, 2, 8, 8).astype(np.float32)
+    out = nd.Crop(nd.array(x), offset=(1, 2), h_w=(4, 5),
+                  num_args=1).asnumpy()
+    np.testing.assert_array_equal(out, x[:, :, 1:5, 2:7])
+    like = nd.zeros((1, 1, 3, 3))
+    out = nd.Crop(nd.array(x), like, num_args=2, center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out, x[:, :, 2:5, 2:5])
+
+
+def test_moments():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(0, 2))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(axis=(0, 2)), rtol=1e-4)
+    mean2, var2 = nd.moments(nd.array(x), axes=(1,), keepdims=True)
+    assert var2.shape == (2, 1, 4)
+
+
+def test_svm_output_grad():
+    x = np.array([[2.0, 1.0, -1.0]], np.float32)
+    y = np.array([0.0], np.float32)
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(data, nd.array(y), margin=1.0,
+                           regularization_coefficient=0.5, use_linear=True)
+    out.backward()
+    # forward is identity
+    np.testing.assert_array_equal(out.asnumpy(), x)
+    g = data.grad.asnumpy()
+    # class1: 1 - 2 + 1 = 0 violation (not > 0) -> 0; class2: 1-2-1=-2 -> 0
+    np.testing.assert_allclose(g, np.zeros_like(g))
+    x = np.array([[0.5, 1.0, -1.0]], np.float32)
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(data, nd.array(y), margin=1.0,
+                           regularization_coefficient=0.5, use_linear=True)
+    out.backward()
+    g = data.grad.asnumpy()
+    # class1 violates (1 - 0.5 + 1 = 1.5 > 0): +reg there, -reg at y
+    np.testing.assert_allclose(g, [[-0.5, 0.5, 0.0]])
+
+
+def test_im2col_col2im_roundtrip():
+    x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert cols.shape == (2, 27, 36)
+    # col2im(im2col(x)) == x * (number of windows covering each pixel)
+    back = nd.col2im(cols, output_size=(6, 6), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1)).asnumpy()
+    ones = np.ones_like(x)
+    cols1 = nd.im2col(nd.array(ones), kernel=(3, 3), stride=(1, 1),
+                      pad=(1, 1))
+    counts = nd.col2im(cols1, output_size=(6, 6), kernel=(3, 3),
+                       stride=(1, 1), pad=(1, 1)).asnumpy()
+    np.testing.assert_allclose(back, x * counts, rtol=1e-5)
+
+
+def test_rnn_flat_param_op_matches_gluon():
+    """nd.RNN with the packed flat parameter vector must match the gluon
+    LSTM layer (which uses the per-array _fused_rnn)."""
+    from mxnet_tpu import gluon
+
+    T, B, I, H = 3, 2, 4, 5
+    x = np.random.randn(T, B, I).astype(np.float32)
+    layer = gluon.rnn.LSTM(H, num_layers=1)
+    layer.initialize(mx.init.Xavier())
+    out_ref = layer(nd.array(x)).asnumpy()
+
+    p = {k.split(".")[-1]: v for k, v in layer.collect_params().items()}
+    names = [n for n in p]
+    get = lambda frag: next(v for n, v in p.items() if frag in n)
+    flat = np.concatenate([
+        get("l0_i2h_weight").data().asnumpy().ravel(),
+        get("l0_h2h_weight").data().asnumpy().ravel(),
+        get("l0_i2h_bias").data().asnumpy().ravel(),
+        get("l0_h2h_bias").data().asnumpy().ravel(),
+    ])
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    out, hN, cN = nd.RNN(nd.array(x), nd.array(flat), nd.array(h0),
+                         nd.array(c0), state_size=H, num_layers=1,
+                         mode="lstm", state_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), out_ref, rtol=1e-5, atol=1e-5)
+    assert hN.shape == (1, B, H) and cN.shape == (1, B, H)
+
+
+def test_all_finite():
+    assert float(nd.all_finite(nd.array(np.ones(4, np.float32)))
+                 .asnumpy()[0]) == 1.0
+    bad = np.array([1.0, np.inf], np.float32)
+    assert float(nd.all_finite(nd.array(bad)).asnumpy()[0]) == 0.0
+    ok = nd.multi_all_finite(nd.array(np.ones(3, np.float32)),
+                             nd.array(bad), num_arrays=2)
+    assert float(ok.asnumpy()[0]) == 0.0
+
+
+def test_digamma_and_ravel_aliases():
+    x = np.array([0.5, 1.0, 2.5], np.float32)
+    out = nd.digamma(nd.array(x)).asnumpy()
+    # digamma(1) = -euler_gamma
+    np.testing.assert_allclose(out[1], -0.5772157, rtol=1e-4)
+    idx = nd.array(np.array([[0, 1], [2, 3]], np.float32))
+    flat = nd.ravel_multi_index(idx, shape=(3, 4)).asnumpy()
+    np.testing.assert_array_equal(flat, [2, 7])  # (0,2)->2, (1,3)->7
+    back = nd.unravel_index(nd.array(np.array([2, 7], np.float32)),
+                            shape=(3, 4)).asnumpy()
+    np.testing.assert_array_equal(back, [[0, 1], [2, 3]])
