@@ -44,6 +44,7 @@ from . import image
 from . import callback
 from . import model
 from . import operator
+from . import rnn
 from . import profiler
 from . import runtime
 from . import util

@@ -100,6 +100,18 @@ class Module(BaseModule):
         from .. import initializer as _init
 
         default_init = initializer or _init.Uniform(0.01)
+        # per-variable init attrs (e.g. mx.rnn LSTMCell forget-gate bias)
+        # override the module-level default, as in the reference
+        from ..symbol.symbol import _topo_order
+
+        var_inits = {}
+        for node in _topo_order(self._symbol._entries):
+            if node.is_variable():
+                init_attr = (node.vattrs or {}).get("init")
+                if init_attr is not None:
+                    var_inits[node.name] = (
+                        _init.create(init_attr) if isinstance(init_attr, str)
+                        else init_attr)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params and name in arg_params:
@@ -109,7 +121,7 @@ class Module(BaseModule):
                     f"param {name!r} missing from arg_params "
                     f"(pass allow_missing=True to initialize it)")
             else:
-                default_init(name, arr)
+                var_inits.get(name, default_init)(name, arr)
         for name, arr in self._exec.aux_dict.items():
             if aux_params and name in aux_params:
                 arr._set_data(aux_params[name].copyto(self._context)._data)

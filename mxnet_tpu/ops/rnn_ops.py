@@ -106,3 +106,21 @@ def _fused_rnn(data, key, state_h, state_c, *weights, mode="lstm",
     if mode == "lstm":
         return xs, h_n, jnp.stack(c_out, axis=0)
     return xs, h_n
+
+
+@register("_begin_state_zeros", differentiable=False)
+def _begin_state_zeros(data, num_hidden=0, batch_axis=0):
+    """Zero initial state (B, H) derived from an input's batch dim — the
+    TPU-native replacement for the reference's shape-(0,H) deferred zeros
+    (mx.rnn BaseRNNCell.begin_state)."""
+    return jnp.zeros((data.shape[int(batch_axis)], int(num_hidden)),
+                     data.dtype)
+
+
+@register("_begin_state_zeros_layers", differentiable=False)
+def _begin_state_zeros_layers(data, num_hidden=0, num_layers=1,
+                              batch_axis=1):
+    """Zero initial state (L, B, H); batch_axis selects the batch dim of
+    the input (1 for a merged TNC tensor, 0 for a (B, C) step slice)."""
+    return jnp.zeros((int(num_layers), data.shape[int(batch_axis)],
+                      int(num_hidden)), data.dtype)

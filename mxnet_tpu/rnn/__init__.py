@@ -1,0 +1,20 @@
+"""Legacy ``mx.rnn`` API: symbolic RNN cells, bucketing iterator, RNN
+checkpoints (reference: python/mxnet/rnn/ — rnn_cell.py, io.py, rnn.py).
+
+The cells compose registered ops through the shared op registry, so they
+work with both ``mx.sym`` and ``mx.nd`` spellings, and an unrolled graph
+compiles to a single XLA program through the symbolic executor — the
+TPU-native replacement for the reference's per-timestep engine pushes.
+"""
+from .rnn_cell import (BaseRNNCell, RNNParams, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       ModifierCell, DropoutCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
+
+__all__ = ["BaseRNNCell", "RNNParams", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "ModifierCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BucketSentenceIter", "encode_sentences", "save_rnn_checkpoint",
+           "load_rnn_checkpoint", "do_rnn_checkpoint"]

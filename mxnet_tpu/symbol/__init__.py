@@ -12,7 +12,8 @@ import inspect
 from ..base import MXNetError
 from ..ops import registry as _reg
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
-                     _apply_sym, _auto_name, _Node, _op_arg_names, _AUX_ARGS)
+                     _apply_sym, _auto_name, _Node, _op_arg_names, _AUX_ARGS,
+                     static_num_outputs)
 from .executor import Executor
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
@@ -80,7 +81,9 @@ def _make_sym_stub(op):
             raise MXNetError(f"{op.name}: unknown attrs {sorted(bad)}")
         entries = [s._entries[0] for s in sym_inputs]
         node = _Node(op.name, name, kwargs, entries)
-        return Symbol([(node, 0)])
+        n_out = static_num_outputs(op.name, kwargs)
+        node.num_outputs = n_out
+        return Symbol([(node, i) for i in range(n_out)])
 
     stub.__name__ = op.name
     stub.__doc__ = op.__doc__

@@ -105,6 +105,22 @@ def _op_arg_names(op_name: str) -> Tuple[List[str], Optional[str]]:
     return req, var
 
 
+def static_num_outputs(op_name: str, attrs: dict) -> int:
+    """Build-time output multiplicity for ops whose count is known from
+    attrs — lets ``sym.SliceChannel(x, num_outputs=3)[i]`` index outputs
+    before any evaluation (reference: nnvm FNumOutputs)."""
+    if op_name in ("SliceChannel", "split"):
+        return int(attrs.get("num_outputs", 1))
+    if op_name in ("moments", "linalg_slogdet", "linalg_gelqf"):
+        return 2
+    if op_name == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    if op_name in ("RNN", "_fused_rnn"):
+        if op_name == "_fused_rnn" or attrs.get("state_outputs"):
+            return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+    return 1
+
+
 def _infer_param_shape(op_name: str, arg_name: str, data_shape, attrs):
     """Shape of an auto-created parameter variable given the op's data input
     shape — the symbolic twin of Gluon deferred init (reference: per-op
@@ -148,6 +164,26 @@ def _infer_param_shape(op_name: str, arg_name: str, data_shape, attrs):
                      "LogisticRegressionOutput"):
         if arg_name == "label":
             return tuple(data_shape)
+    elif op_name == "RNN":
+        if arg_name == "parameters":
+            # packed flat vector size (reference rnn-inl.h GetRnnParamSize)
+            gates = {"lstm": 4, "gru": 3, "rnn_relu": 1,
+                     "rnn_tanh": 1}[a.get("mode", "lstm")]
+            H = int(a["state_size"])
+            L = int(a.get("num_layers", 1))
+            dirs = 2 if a.get("bidirectional", False) else 1
+            I = int(data_shape[2])
+            size = 0
+            for layer in range(L):
+                inp = I if layer == 0 else H * dirs
+                size += dirs * (gates * H * inp + gates * H * H
+                                + 2 * gates * H)
+            return (size,)
+        if arg_name in ("state", "state_cell"):
+            H = int(a["state_size"])
+            L = int(a.get("num_layers", 1))
+            dirs = 2 if a.get("bidirectional", False) else 1
+            return (L * dirs, int(data_shape[1]), H)
     return None
 
 
@@ -627,7 +663,9 @@ def load_json(json_str: str) -> Symbol:
             attrs = {k: _parse_attr(v)
                      for k, v in nd_.get("attrs", {}).items()}
             inputs = [(built[i], oi) for i, oi, *_ in nd_["inputs"]]
-            built.append(_Node(nd_["op"], nd_["name"], attrs, inputs))
+            node = _Node(nd_["op"], nd_["name"], attrs, inputs)
+            node.num_outputs = static_num_outputs(nd_["op"], attrs)
+            built.append(node)
     heads = data.get("heads", [[len(built) - 1, 0, 0]])
     return Symbol([(built[i], oi) for i, oi, *_ in heads])
 
