@@ -40,14 +40,18 @@ __all__ = [
 
 
 class _TapeNode:
-    __slots__ = ("vjp_fn", "input_ids", "input_arrays", "output_ids", "outputs")
+    __slots__ = ("vjp_fn", "input_ids", "input_arrays", "output_ids",
+                 "outputs", "fwd_fn")
 
-    def __init__(self, vjp_fn, inputs, outputs):
+    def __init__(self, vjp_fn, inputs, outputs, fwd_fn=None):
         self.vjp_fn = vjp_fn
         self.input_arrays = list(inputs)
         self.input_ids = [id(a) for a in inputs]
         self.outputs = list(outputs)
         self.output_ids = [id(o) for o in outputs]
+        # pure forward fn(*input_arrays) -> outputs; kept so create_graph
+        # backward can re-linearize the op differentiably (higher-order)
+        self.fwd_fn = fwd_fn
 
 
 class _RowSparseCT:
@@ -164,8 +168,8 @@ def set_training(flag: bool) -> bool:
 # ---------------------------------------------------------------------------
 # tape construction (called from ops.registry on every eager op)
 # ---------------------------------------------------------------------------
-def record_node(vjp_fn, inputs, outputs, input_nds=None) -> None:
-    _state.tape.append(_TapeNode(vjp_fn, inputs, outputs))
+def record_node(vjp_fn, inputs, outputs, input_nds=None, fwd_fn=None) -> None:
+    _state.tape.append(_TapeNode(vjp_fn, inputs, outputs, fwd_fn=fwd_fn))
     if input_nds:
         for nd in input_nds:
             register_leaf(nd)
@@ -249,6 +253,77 @@ def _walk_tape(head_pairs, retain_graph=False):
     return grads
 
 
+def _walk_tape_create_graph(head_pairs):
+    """Create-graph reverse walk: every vjp application and cotangent
+    accumulation is itself RECORDED on the tape (by re-linearizing each
+    node's stored forward with jax.vjp), so the returned gradients support
+    further backward passes — arbitrary-order eager gradients
+    (reference: Imperative::Backward create_graph=True path).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    grads: Dict[int, Any] = {}
+    keep: Dict[int, Any] = {}
+    for arr, ct in head_pairs:
+        grads[id(arr)] = ct
+        keep[id(arr)] = arr
+
+    snapshot = list(_state.tape)
+    for node in reversed(snapshot):
+        if not any(oid in grads for oid in node.output_ids):
+            continue
+        if node.fwd_fn is None:
+            raise MXNetError(
+                "create_graph=True: a recorded op without a re-linearizable "
+                "forward (custom Function or sparse-grad Embedding) is on "
+                "the gradient path; higher-order gradients are unavailable "
+                "through it")
+        cts = []
+        for out, oid in zip(node.outputs, node.output_ids):
+            g = grads.get(oid)
+            if g is None:
+                g = jnp.zeros_like(out)
+            elif isinstance(g, _RowSparseCT):
+                g = g.densify()
+            cts.append(g)
+        n_in = len(node.input_arrays)
+        fwd = node.fwd_fn
+
+        def g_fn(*args, _fwd=fwd, _n=n_in):
+            xs, cs = args[:_n], args[_n:]
+            out, vjp = jax.vjp(_fwd, *xs)
+            # cotangent tree must match _fwd's output tree exactly
+            ct = tuple(cs) if isinstance(out, (tuple, list)) else cs[0]
+            return vjp(ct)
+
+        all_in = list(node.input_arrays) + cts
+        in_grads, vjp2 = jax.vjp(g_fn, *all_in)
+        # _walk_tape hands single-output nodes a bare array; vjp2 expects
+        # g_fn's output tree (a tuple) — adapt when arities differ
+        if len(in_grads) == 1:
+            rec_vjp = (lambda ct, _v=vjp2: _v((ct,)))
+        else:
+            rec_vjp = vjp2
+        record_node(rec_vjp, all_in, list(in_grads), fwd_fn=g_fn)
+        for arr, aid, g in zip(node.input_arrays, node.input_ids, in_grads):
+            if g is None or _is_float0(g):
+                continue
+            prev = grads.get(aid)
+            if prev is None:
+                grads[aid] = g
+                keep[aid] = arr
+            else:
+                if isinstance(prev, _RowSparseCT):
+                    prev = prev.densify()
+                s = prev + g
+                record_node(lambda ct: (ct, ct), [prev, g], [s],
+                            fwd_fn=lambda a, b: a + b)
+                grads[aid] = s
+                keep[aid] = arr
+    return grads
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True) -> None:
     """Compute gradients of `heads` w.r.t. all attach_grad()-ed arrays on the
     tape, writing into their .grad buffers per grad_req ('write'|'add').
@@ -316,16 +391,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
          train_mode=True):
     """Return gradients of heads w.r.t. variables (reference: autograd.grad ~L350).
 
-    ``create_graph=True`` (higher-order eager grad) is not supported; use the
-    functional ``mx.jit.grad`` path for higher-order derivatives.
+    ``create_graph=True`` records the gradient computation itself on the
+    tape, so the returned arrays support further ``backward()``/``grad()``
+    calls — higher-order eager derivatives (implies retain_graph).
     """
     from .ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True is not supported by the eager tape; "
-            "use jax.grad via hybridized blocks for higher-order gradients"
-        )
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
@@ -342,7 +413,10 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     for h, hg in zip(heads, head_grads):
         ct = hg._data if hg is not None else jnp.ones_like(h._data)
         pairs.append((h._data, ct))
-    grads = _walk_tape(pairs, retain_graph=bool(retain_graph))
+    if create_graph:
+        grads = _walk_tape_create_graph(pairs)
+    else:
+        grads = _walk_tape(pairs, retain_graph=bool(retain_graph))
 
     out = []
     for v in variables:

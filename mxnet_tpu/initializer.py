@@ -31,6 +31,13 @@ def create(init, **kwargs) -> "Initializer":
     if isinstance(init, Initializer):
         return init
     if isinstance(init, str):
+        if init.startswith("["):
+            # Initializer.dumps() format: '["name", {kwargs}]' (reference:
+            # the __init__ variable attr round-trip)
+            import json
+
+            name, kw = json.loads(init)
+            return create(name, **kw)
         key = init.lower()
         if key not in _REGISTRY:
             raise MXNetError(f"unknown initializer {init!r}")
@@ -48,6 +55,12 @@ class Initializer:
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        """'["name", {kwargs}]' (reference: Initializer.dumps)."""
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def init_array(self, name: str, shape, dtype) -> np.ndarray:
         from .base import dtype_np

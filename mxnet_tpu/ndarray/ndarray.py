@@ -299,9 +299,19 @@ class NDArray:
         return self._binary(other, "broadcast_mod", reverse=True)
 
     def __pow__(self, other):
+        # python scalars stay STATIC attrs (reference _power_scalar): an
+        # exponent materialized as an array input would add a
+        # d/d(exponent) = x^b*log(x) gradient path — NaN for x < 0 even
+        # under a zero cotangent in second-order backward
+        if isinstance(other, (int, float, np.generic)):
+            return _reg.invoke_by_name("_power_scalar", [self],
+                                       scalar=float(other))
         return self._binary(other, "broadcast_power")
 
     def __rpow__(self, other):
+        if isinstance(other, (int, float, np.generic)):
+            return _reg.invoke_by_name("_rpower_scalar", [self],
+                                       scalar=float(other))
         return self._binary(other, "broadcast_power", reverse=True)
 
     def __neg__(self):

@@ -800,7 +800,7 @@ def rnn_op(data, parameters, state, *state_cell, state_size=0, num_layers=1,
     inference-ignored here (the stateless op has no RNG key input);
     gluon.rnn layers use _fused_rnn with an explicit key for training.
     """
-    from .rnn_ops import _fused_rnn
+    from .rnn_ops import _fused_rnn, rnn_packed_layout
 
     if use_sequence_length:
         raise MXNetError("RNN: use_sequence_length is not supported; mask "
@@ -810,33 +810,25 @@ def rnn_op(data, parameters, state, *state_cell, state_size=0, num_layers=1,
         raise MXNetError("RNN: lstm_state_clip_* / projection_size are not "
                          "supported")
 
-    gates = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
     H = int(state_size)
     dirs = 2 if bidirectional else 1
-    I = data.shape[2]
     flat = parameters
-    # weights first (i2h then h2h per layer/direction), then all biases
-    w_slices, b_slices = [], []
-    off = 0
-    for layer in range(num_layers):
-        inp = I if layer == 0 else H * dirs
-        for _ in range(dirs):
-            w_slices.append((off, (gates * H, inp))); off += gates * H * inp
-            w_slices.append((off, (gates * H, H))); off += gates * H * H
-    for layer in range(num_layers):
-        for _ in range(dirs):
-            b_slices.append((off, (gates * H,))); off += gates * H
-            b_slices.append((off, (gates * H,))); off += gates * H
+    entries, _ = rnn_packed_layout(mode, data.shape[2], H, num_layers,
+                                   bidirectional)
+    by_key = {(l, d, g, k): (off, shp) for l, d, g, k, off, shp in entries}
 
-    def take(spec):
-        o, shp = spec
+    def take(key):
+        off, shp = by_key[key]
         return jax.lax.dynamic_slice_in_dim(
-            flat, o, int(np.prod(shp))).reshape(shp)
+            flat, off, int(np.prod(shp))).reshape(shp)
 
     weights = []
-    for s in range(num_layers * dirs):
-        weights.extend([take(w_slices[2 * s]), take(w_slices[2 * s + 1]),
-                        take(b_slices[2 * s]), take(b_slices[2 * s + 1])])
+    for layer in range(num_layers):
+        for d in range(dirs):
+            weights.extend([take((layer, d, "i2h", "weight")),
+                            take((layer, d, "h2h", "weight")),
+                            take((layer, d, "i2h", "bias")),
+                            take((layer, d, "h2h", "bias"))])
     cell = state_cell[0] if mode == "lstm" else jnp.zeros_like(state)
     outs = _fused_rnn(data, None, state, cell, *weights, mode=mode,
                       state_size=H, num_layers=num_layers,

@@ -168,7 +168,8 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
             and arrays
             and any(_is_float(a) for a in arrays)
         ):
-            outs, vjp_fn = _vjp(_wrap_detached(jfn, inputs), arrays)
+            wrapped = _wrap_detached(jfn, inputs)
+            outs, vjp_fn = _vjp(wrapped, arrays)
             seq = isinstance(outs, (tuple, list))
             out_list = list(outs) if seq else [outs]
             # identity-like ops (e.g. SVMOutput's forward) can return an
@@ -182,7 +183,8 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
                 out_list = [jnp.copy(o) if id(o) in in_ids else o
                             for o in out_list]
                 outs = type(outs)(out_list) if seq else out_list[0]
-            autograd.record_node(vjp_fn, arrays, out_list, input_nds=inputs)
+            autograd.record_node(vjp_fn, arrays, out_list, input_nds=inputs,
+                                 fwd_fn=wrapped)
         else:
             outs = jfn(*arrays)
         if engine.is_naive():
@@ -248,9 +250,11 @@ def invoke_fn(fn, inputs, out=None):
 
     traced = any(_is_tracer(a) for a in arrays)
     if not traced and autograd.is_recording() and any(_is_float(a) for a in arrays):
-        outs, vjp_fn = _vjp(_wrap_detached(fn, inputs), arrays)
+        wrapped = _wrap_detached(fn, inputs)
+        outs, vjp_fn = _vjp(wrapped, arrays)
         out_list = outs if isinstance(outs, (tuple, list)) else [outs]
-        autograd.record_node(vjp_fn, arrays, list(out_list), input_nds=inputs)
+        autograd.record_node(vjp_fn, arrays, list(out_list), input_nds=inputs,
+                             fwd_fn=wrapped)
     else:
         if traced:
             arrays = _stop_detached(arrays, inputs)

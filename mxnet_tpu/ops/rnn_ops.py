@@ -124,3 +124,35 @@ def _begin_state_zeros_layers(data, num_hidden=0, num_layers=1,
     the input (1 for a merged TNC tensor, 0 for a (B, C) step slice)."""
     return jnp.zeros((int(num_layers), data.shape[int(batch_axis)],
                       int(num_hidden)), data.dtype)
+
+
+def rnn_packed_layout(mode, input_size, state_size, num_layers,
+                      bidirectional):
+    """Single source of truth for the packed flat RNN parameter vector
+    (reference rnn-inl.h GetRnnParamSize: weights layer/direction-major,
+    i2h then h2h, followed by all biases in the same order).
+
+    Returns (entries, total) where entries are
+    (layer, direction, group 'i2h'|'h2h', kind 'weight'|'bias',
+    offset, shape).  Consumed by the RNN op, symbolic shape inference,
+    and mx.rnn.FusedRNNCell pack/unpack.
+    """
+    gates = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
+    H = int(state_size)
+    dirs = 2 if bidirectional else 1
+    entries = []
+    off = 0
+    for layer in range(int(num_layers)):
+        inp = int(input_size) if layer == 0 else H * dirs
+        for d in range(dirs):
+            entries.append((layer, d, "i2h", "weight", off, (gates * H, inp)))
+            off += gates * H * inp
+            entries.append((layer, d, "h2h", "weight", off, (gates * H, H)))
+            off += gates * H * H
+    for layer in range(int(num_layers)):
+        for d in range(dirs):
+            entries.append((layer, d, "i2h", "bias", off, (gates * H,)))
+            off += gates * H
+            entries.append((layer, d, "h2h", "bias", off, (gates * H,)))
+            off += gates * H
+    return entries, off

@@ -70,7 +70,9 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+        # keep empty buckets 2-D so reset()'s label shift is well-formed
+        self.data = [np.asarray(i, dtype=dtype).reshape(-1, blen)
+                     for i, blen in zip(self.data, buckets)]
         if ndiscard:
             import logging
 

@@ -376,41 +376,38 @@ class FusedRNNCell(BaseRNNCell):
 
     def _slice_plan(self, input_size):
         """(name, offset, shape) for every per-gate array inside the flat
-        vector, in the RNN op's packing order (weights, then biases)."""
-        H, G = self._num_hidden, self._num_gates
-        dirs = 2 if self._bidirectional else 1
-        dnames = ("l", "r")[:dirs]
+        vector, derived from the shared rnn_packed_layout (the single
+        source of truth also used by the RNN op and shape inference)."""
+        from ..ops.rnn_ops import rnn_packed_layout
+
+        H = self._num_hidden
+        dnames = ("l", "r")
+        entries, total = rnn_packed_layout(
+            self._mode, input_size, H, self._num_layers,
+            self._bidirectional)
         plan = []
-        off = 0
-        for layer in range(self._num_layers):
-            inp = input_size if layer == 0 else H * dirs
-            for d in dnames:
-                for group, cols in (("i2h", inp), ("h2h", H)):
-                    for gate in self._gate_names:
-                        plan.append((f"{self._prefix}{d}{layer}_{group}"
-                                     f"{gate}_weight", off, (H, cols)))
-                        off += H * cols
-        for layer in range(self._num_layers):
-            for d in dnames:
-                for group in ("i2h", "h2h"):
-                    for gate in self._gate_names:
-                        plan.append((f"{self._prefix}{d}{layer}_{group}"
-                                     f"{gate}_bias", off, (H,)))
-                        off += H
-        return plan, off
+        for layer, d, group, kind, off, shape in entries:
+            cols = shape[1] if kind == "weight" else None
+            per_gate = H * cols if kind == "weight" else H
+            for g, gate in enumerate(self._gate_names):
+                gshape = (H, cols) if kind == "weight" else (H,)
+                plan.append((f"{self._prefix}{dnames[d]}{layer}_{group}"
+                             f"{gate}_{kind}", off + g * per_gate, gshape))
+        return plan, total
 
     def _input_size_from(self, total):
-        """Solve the layer-0 input size from the flat vector length."""
-        H, G = self._num_hidden, self._num_gates
-        dirs = 2 if self._bidirectional else 1
-        rest = 0
-        for layer in range(1, self._num_layers):
-            rest += dirs * G * H * (H * dirs)
-        rest += self._num_layers * dirs * (G * H * H + 2 * G * H)
-        i_total = total - rest
-        assert i_total % (dirs * G * H) == 0, \
+        """Solve the layer-0 input size from the flat vector length: the
+        total is affine in the input size."""
+        from ..ops.rnn_ops import rnn_packed_layout
+
+        _, t0 = rnn_packed_layout(self._mode, 0, self._num_hidden,
+                                  self._num_layers, self._bidirectional)
+        _, t1 = rnn_packed_layout(self._mode, 1, self._num_hidden,
+                                  self._num_layers, self._bidirectional)
+        slope = t1 - t0
+        assert (total - t0) % slope == 0, \
             f"flat parameter size {total} inconsistent with cell config"
-        return i_total // (dirs * G * H)
+        return (total - t0) // slope
 
     def unpack_weights(self, args):
         args = dict(args)

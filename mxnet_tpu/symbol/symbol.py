@@ -166,19 +166,14 @@ def _infer_param_shape(op_name: str, arg_name: str, data_shape, attrs):
             return tuple(data_shape)
     elif op_name == "RNN":
         if arg_name == "parameters":
-            # packed flat vector size (reference rnn-inl.h GetRnnParamSize)
-            gates = {"lstm": 4, "gru": 3, "rnn_relu": 1,
-                     "rnn_tanh": 1}[a.get("mode", "lstm")]
-            H = int(a["state_size"])
-            L = int(a.get("num_layers", 1))
-            dirs = 2 if a.get("bidirectional", False) else 1
-            I = int(data_shape[2])
-            size = 0
-            for layer in range(L):
-                inp = I if layer == 0 else H * dirs
-                size += dirs * (gates * H * inp + gates * H * H
-                                + 2 * gates * H)
-            return (size,)
+            # packed flat vector size from the shared layout helper
+            from ..ops.rnn_ops import rnn_packed_layout
+
+            _, total = rnn_packed_layout(
+                a.get("mode", "lstm"), int(data_shape[2]),
+                int(a["state_size"]), int(a.get("num_layers", 1)),
+                a.get("bidirectional", False))
+            return (total,)
         if arg_name in ("state", "state_cell"):
             H = int(a["state_size"])
             L = int(a.get("num_layers", 1))
@@ -445,6 +440,11 @@ class Symbol:
                     vat["__shape__"] = str(tuple(n.vattrs["shape"]))
                 if n.vattrs.get("dtype") is not None:
                     vat["__dtype__"] = str(n.vattrs["dtype"])
+                init = n.vattrs.get("init")
+                if init is not None:
+                    # reference format: '["name", {kwargs}]' (__init__ attr)
+                    vat["__init__"] = (init if isinstance(init, str)
+                                       else init.dumps())
                 if vat:
                     entry["attrs"] = vat
             else:
@@ -658,6 +658,10 @@ def load_json(json_str: str) -> Symbol:
                 vattrs["shape"] = tuple(ast.literal_eval(raw["__shape__"]))
             if "__dtype__" in raw:
                 vattrs["dtype"] = raw["__dtype__"]
+            if "__init__" in raw:
+                from .. import initializer as _init
+
+                vattrs["init"] = _init.create(raw["__init__"])
             built.append(_Node(None, nd_["name"], {}, [], vattrs=vattrs))
         else:
             attrs = {k: _parse_attr(v)

@@ -147,3 +147,58 @@ def test_dropout_respects_mode():
     arr = y.asnumpy()
     assert (arr == 0).sum() > 10  # some were dropped
     assert abs(arr.mean() - 1.0) < 0.3  # scaled to keep expectation
+
+
+def test_higher_order_grad_polynomial():
+    # y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x, d3y/dx3 = 6
+    x = nd.array([2.0, -1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        np.testing.assert_allclose(g1.asnumpy(), 3 * np.array([2.0, -1.5]) ** 2,
+                                   rtol=1e-5)
+        g2 = autograd.grad(g1, [x], create_graph=True)[0]
+        np.testing.assert_allclose(g2.asnumpy(), 6 * np.array([2.0, -1.5]),
+                                   rtol=1e-5)
+        g3 = autograd.grad(g2, [x], create_graph=False)[0]
+    np.testing.assert_allclose(g3.asnumpy(), [6.0, 6.0], rtol=1e-5)
+
+
+def test_higher_order_grad_sin_backward():
+    # second derivative via grad() then backward(): d2/dx2 sin(x) = -sin(x)
+    v = np.array([0.3, 1.1, -0.7], np.float32)
+    x = nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        s = g1.sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(v), rtol=1e-5)
+
+
+def test_higher_order_through_composition():
+    # f(x) = exp(2x); f'' = 4 exp(2x); mixes registered ops on the tape
+    v = np.array([0.1, -0.4], np.float32)
+    x = nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x * 2)
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        g2 = autograd.grad(g1, [x])[0]
+    np.testing.assert_allclose(g2.asnumpy(), 4 * np.exp(2 * v), rtol=1e-5)
+
+
+def test_second_order_scalar_pow_negative_base():
+    # x**4 with python-scalar exponent must not open a d/d(exponent) path
+    # (x^b log x is NaN for x<0 and would poison second-order backward)
+    x = nd.array(np.array([-0.78, 1.3], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        s = g1.sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               12 * np.array([-0.78, 1.3]) ** 2, rtol=1e-5)

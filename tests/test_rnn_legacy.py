@@ -276,3 +276,25 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     for k in args:
         np.testing.assert_allclose(arg2[k].asnumpy(), args[k].asnumpy(),
                                    rtol=1e-6)
+
+
+def test_bucket_iter_empty_bucket_ok():
+    sents = [[1, 2], [3, 4], [5, 6], [7, 8]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[2, 10],
+                                   invalid_label=0)
+    batches = list(it)
+    assert all(b.bucket_key == 2 for b in batches)
+    assert len(batches) == 2
+
+
+def test_init_attr_survives_json_roundtrip():
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_", forget_bias=3.0)
+    outputs, _ = cell.unroll(2, inputs=mx.sym.Variable("data"),
+                             merge_outputs=True)
+    sym2 = mx.sym.load_json(outputs.tojson())
+    mod = mx.mod.Module(sym2, data_names=["data"], label_names=[])
+    mod.bind(data_shapes=[("data", (1, 2, 3))], for_training=False)
+    mod.init_params(initializer=mx.init.Zero())
+    arg_params, _ = mod.get_params()
+    bias = arg_params["lstm_i2h_bias"].asnumpy()
+    np.testing.assert_allclose(bias[4:8], 3.0)
