@@ -276,9 +276,9 @@ def _walk_tape_create_graph(head_pairs):
         if node.fwd_fn is None:
             raise MXNetError(
                 "create_graph=True: a recorded op without a re-linearizable "
-                "forward (custom Function or sparse-grad Embedding) is on "
-                "the gradient path; higher-order gradients are unavailable "
-                "through it")
+                "forward (a custom autograd.Function or a sparse-grad "
+                "Embedding) is on the gradient path; higher-order gradients "
+                "are unavailable through it")
         cts = []
         for out, oid in zip(node.outputs, node.output_ids):
             g = grads.get(oid)

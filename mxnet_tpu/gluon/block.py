@@ -364,8 +364,16 @@ class CachedOp:
                 pc, kc, *ic = vjp_fn(cots if isinstance(cots, tuple) else (cots,))
                 return list(pc) + [kc] + list(ic)
 
+            n_params = len(arrays)
+
+            def flat_fwd(*flat, _jfn=jfn, _np_=n_params):
+                # flat-args twin of jfn for create_graph re-linearization
+                return _jfn(tuple(flat[:_np_]), flat[_np_],
+                            *flat[_np_ + 1:])
+
             autograd.record_node(adapter, flat_inputs, list(outs),
-                                 input_nds=param_nds + in_nds)
+                                 input_nds=param_nds + in_nds,
+                                 fwd_fn=flat_fwd)
         else:
             outs = jfn(arrays, key, *in_arrays)
 

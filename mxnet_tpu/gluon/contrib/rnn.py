@@ -1,0 +1,302 @@
+"""Gluon contrib RNN cells (reference: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py + rnn_cell.py): convolutional recurrences
+(Conv{1,2,3}D{RNN,LSTM,GRU}Cell), VariationalDropoutCell, LSTMPCell.
+
+TPU-native: each step's gate math is Convolution/FullyConnected registered
+ops, so an unrolled sequence compiles into one XLA program and the conv
+gates land on the MXU like any other conv.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..rnn.rnn_cell import HybridRecurrentCell, _ModifierCell as ModifierCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell", "LSTMPCell"]
+
+
+def _tuplify(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Convolutional recurrence base (reference conv_rnn_cell.py
+    _BaseConvRNNCell ~L40).  input_shape is (C, *spatial), required up
+    front: the recurrent state's spatial extent must be known to allocate
+    h2h weights and begin_state."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, prefix=None, params=None, conv_dims=2,
+                 num_gates=1):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._conv_dims = conv_dims
+        self._num_gates = num_gates
+        self._activation = activation
+        self._i2h_kernel = _tuplify(i2h_kernel, conv_dims)
+        self._h2h_kernel = _tuplify(h2h_kernel, conv_dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError("h2h_kernel dims must be odd to preserve "
+                                 f"the state shape, got {self._h2h_kernel}")
+        self._i2h_pad = _tuplify(i2h_pad, conv_dims)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+
+        in_c = self._input_shape[0]
+        spatial = self._input_shape[1:]
+        self._state_shape = (hidden_channels,) + tuple(
+            s + 2 * p - k + 1
+            for s, p, k in zip(spatial, self._i2h_pad, self._i2h_kernel))
+        G = num_gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(G * hidden_channels, in_c) + self._i2h_kernel)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(G * hidden_channels, hidden_channels)
+                + self._h2h_kernel)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(G * hidden_channels,),
+                init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(G * hidden_channels,),
+                init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NC" + "DHW"[-self._conv_dims:]}]
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        G = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=G * self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=G * self._hidden_channels)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", prefix=None, params=None,
+                 conv_dims=2):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, prefix=prefix,
+                         params=params, conv_dims=conv_dims, num_gates=1)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        output = self._get_activation(F, i2h + h2h, self._activation)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", prefix=None, params=None,
+                 conv_dims=2):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, prefix=prefix,
+                         params=params, conv_dims=conv_dims, num_gates=4)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def state_info(self, batch_size=0):
+        info = super().state_info(batch_size)
+        return info + [dict(info[0])]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sliced = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = F.Activation(sliced[1], act_type="sigmoid")
+        in_transform = self._get_activation(F, sliced[2], self._activation)
+        out_gate = F.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", prefix=None, params=None,
+                 conv_dims=2):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, prefix=prefix,
+                         params=params, conv_dims=conv_dims, num_gates=3)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = self._get_activation(F, i2h_n + reset * h2h_n,
+                                          self._activation)
+        next_h = (1.0 - update) * next_h_tmp + update * states[0]
+        return next_h, [next_h]
+
+
+def _make_conv_cell(base, dims, doc_kind):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, activation="tanh",
+                     prefix=None, params=None):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad=i2h_pad,
+                             activation=activation, prefix=prefix,
+                             params=params, conv_dims=dims)
+
+    Cell.__doc__ = (f"{dims}D convolutional {doc_kind} cell "
+                    f"(reference conv_rnn_cell.py)")
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, "RNN")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, "RNN")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, "RNN")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, "LSTM")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, "LSTM")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, "LSTM")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, "GRU")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, "GRU")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, "GRU")
+Conv1DRNNCell.__name__ = "Conv1DRNNCell"
+Conv2DRNNCell.__name__ = "Conv2DRNNCell"
+Conv3DRNNCell.__name__ = "Conv3DRNNCell"
+Conv1DLSTMCell.__name__ = "Conv1DLSTMCell"
+Conv2DLSTMCell.__name__ = "Conv2DLSTMCell"
+Conv3DLSTMCell.__name__ = "Conv3DLSTMCell"
+Conv1DGRUCell.__name__ = "Conv1DGRUCell"
+Conv2DGRUCell.__name__ = "Conv2DGRUCell"
+Conv3DGRUCell.__name__ = "Conv3DGRUCell"
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies the SAME dropout mask at every time step to inputs, states
+    and outputs (Gal & Ghahramani; reference contrib VariationalDropoutCell
+    ~L40).  Masks are sampled once per unroll and cleared by reset()."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, p, like, cache_name):
+        cached = getattr(self, cache_name)
+        if cached is None:
+            cached = F.Dropout(F.ones_like(like), p=p)
+            setattr(self, cache_name, cached)
+        return cached
+
+    def __call__(self, inputs, states):
+        from ... import ndarray as F
+        from ... import autograd
+
+        cell = self.base_cell
+        if self.drop_inputs and autograd.is_training():
+            inputs = inputs * self._mask(F, self.drop_inputs, inputs,
+                                         "_input_mask")
+        if self.drop_states and autograd.is_training():
+            mask = self._mask(F, self.drop_states, states[0], "_state_mask")
+            states = [states[0] * mask] + list(states[1:])
+        output, states = cell(inputs, states)
+        if self.drop_outputs and autograd.is_training():
+            output = output * self._mask(F, self.drop_outputs, output,
+                                         "_output_mask")
+        return output, states
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a learned projection of the hidden state
+    (reference contrib LSTMPCell ~L200: h = W_r (o * tanh(c)))."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape_if_deferred(
+            (4 * self._hidden_size, int(x.shape[-1])))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        sliced = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = F.Activation(sliced[1], act_type="sigmoid")
+        in_transform = F.Activation(sliced[2], act_type="tanh")
+        out_gate = F.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.Activation(next_c, act_type="tanh")
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

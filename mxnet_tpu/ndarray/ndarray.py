@@ -299,17 +299,25 @@ class NDArray:
         return self._binary(other, "broadcast_mod", reverse=True)
 
     def __pow__(self, other):
-        # python scalars stay STATIC attrs (reference _power_scalar): an
-        # exponent materialized as an array input would add a
-        # d/d(exponent) = x^b*log(x) gradient path — NaN for x < 0 even
-        # under a zero cotangent in second-order backward
-        if isinstance(other, (int, float, np.generic)):
+        # Python scalars stay STATIC attrs on float arrays (reference
+        # _power_scalar): an exponent materialized as an array input would
+        # add a d/d(exponent) = x^b*log(x) gradient path — NaN for x < 0
+        # even under a zero cotangent in second-order backward.  Integer
+        # arrays keep the _binary path (scalar cast to the array dtype, no
+        # gradients to protect).
+        from ..base import is_float_dtype
+
+        if (isinstance(other, (int, float, np.generic))
+                and is_float_dtype(self._data.dtype)):
             return _reg.invoke_by_name("_power_scalar", [self],
                                        scalar=float(other))
         return self._binary(other, "broadcast_power")
 
     def __rpow__(self, other):
-        if isinstance(other, (int, float, np.generic)):
+        from ..base import is_float_dtype
+
+        if (isinstance(other, (int, float, np.generic))
+                and is_float_dtype(self._data.dtype)):
             return _reg.invoke_by_name("_rpower_scalar", [self],
                                        scalar=float(other))
         return self._binary(other, "broadcast_power", reverse=True)

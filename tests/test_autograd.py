@@ -202,3 +202,31 @@ def test_second_order_scalar_pow_negative_base():
     s.backward()
     np.testing.assert_allclose(x.grad.asnumpy(),
                                12 * np.array([-0.78, 1.3]) ** 2, rtol=1e-5)
+
+
+def test_int_pow_keeps_dtype():
+    x = nd.array(np.array([2, 3], np.int32), dtype="int32")
+    out = x ** 2
+    assert np.dtype(out.dtype) == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), [4, 9])
+
+
+def test_create_graph_through_hybridized_block():
+    # WGAN-GP style: gradient penalty through a hybridized net
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="tanh"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        penalty = ((gx ** 2).sum(axis=1) ** 0.5 - 1.0) ** 2
+        loss = penalty.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert g.shape == x.shape and np.isfinite(g).all()
+    assert np.abs(g).sum() > 0

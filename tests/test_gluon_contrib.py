@@ -1,0 +1,127 @@
+"""gluon.contrib: conv RNN cells, VariationalDropoutCell, LSTMPCell,
+Estimator fit/evaluate with event handlers.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator)
+
+
+def test_conv2d_lstm_cell_step_and_unroll():
+    B, C, H, W, HC = 2, 3, 8, 8, 4
+    cell = crnn.Conv2DLSTMCell(input_shape=(C, H, W), hidden_channels=HC,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(B, C, H, W).astype(np.float32))
+    states = cell.begin_state(batch_size=B)
+    out, new_states = cell(x, states)
+    assert out.shape == (B, HC, H, W)
+    assert len(new_states) == 2 and new_states[1].shape == (B, HC, H, W)
+    # unroll over time
+    seq = nd.array(np.random.rand(B, 5, C, H, W).astype(np.float32))
+    outputs, _ = cell.unroll(5, seq, layout="NTC", merge_outputs=False)
+    assert len(outputs) == 5
+
+
+def test_conv1d_gru_and_rnn_cells():
+    B, C, L, HC = 2, 3, 10, 5
+    for cls in (crnn.Conv1DGRUCell, crnn.Conv1DRNNCell):
+        cell = cls(input_shape=(C, L), hidden_channels=HC, i2h_kernel=3,
+                   h2h_kernel=3, i2h_pad=1)
+        cell.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(B, C, L).astype(np.float32))
+        out, states = cell(x, cell.begin_state(batch_size=B))
+        assert out.shape == (B, HC, L)
+
+
+def test_conv_cell_even_h2h_kernel_rejected():
+    with pytest.raises(mx.base.MXNetError):
+        crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                            i2h_kernel=3, h2h_kernel=2)
+
+
+def test_variational_dropout_same_mask_across_steps():
+    base = gluon.rnn.RNNCell(16)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.ones((4, 8), np.float32))
+    states = cell.begin_state(batch_size=4)
+    with autograd.record(train_mode=True):
+        out1, states = cell(x, states)
+        out2, states = cell(x, states)
+    m1 = (out1.asnumpy() == 0)
+    m2 = (out2.asnumpy() == 0)
+    # identical zero pattern across time steps (the variational property);
+    # extremely unlikely by chance with 64 elements at p=0.5
+    np.testing.assert_array_equal(m1, m2)
+    assert m1.sum() > 0
+
+
+def test_lstmp_cell_projection_shapes():
+    cell = crnn.LSTMPCell(hidden_size=12, projection_size=5)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(3, 7).astype(np.float32))
+    states = cell.begin_state(batch_size=3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 5)          # projected
+    assert new_states[0].shape == (3, 5)
+    assert new_states[1].shape == (3, 12)  # cell state full size
+    # unroll works and trains
+    seq = nd.array(np.random.rand(3, 4, 7).astype(np.float32))
+    outputs, _ = cell.unroll(4, seq, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (3, 4, 5)
+
+
+class _Toy:
+    """Tiny binary-classification iterable."""
+
+    def __init__(self, n=64, batch=16):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 10).astype(np.float32)
+        w = rng.randn(10, 1).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.float32).ravel()
+        self.batch = batch
+
+    def __iter__(self):
+        for i in range(0, len(self.x), self.batch):
+            yield (nd.array(self.x[i:i + self.batch]),
+                   nd.array(self.y[i:i + self.batch]))
+
+
+def test_estimator_fit_and_evaluate(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    data = _Toy()
+    ckpt = CheckpointHandler(str(tmp_path), monitor=est.train_loss_metric,
+                             save_best=True, mode="min")
+    est.fit(data, epochs=8, event_handlers=[ckpt])
+    scores = est.evaluate(data)
+    acc = [v for k, v in scores.items() if k == "accuracy"][0]
+    assert acc > 0.9, scores
+    import os
+
+    assert os.path.exists(str(tmp_path / "model-epoch8.params"))
+    assert os.path.exists(str(tmp_path / "model-best.params"))
+
+
+def test_estimator_early_stopping():
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    # min_delta large enough that small late-training improvements do not
+    # count, so the stop fires deterministically after the initial drop
+    stopper = EarlyStoppingHandler(monitor=est.train_loss_metric,
+                                   patience=2, min_delta=0.2, mode="min")
+    est.fit(_Toy(), epochs=50, event_handlers=[stopper])
+    assert stopper.current_epoch < 50  # stopped early
