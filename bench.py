@@ -20,7 +20,67 @@ def _setup_platform():
         sys.path.insert(0, "/root/.axon_site")
 
 
+def bench_bert():
+    """Secondary metric (BASELINE): BERT-base MLM pretrain tokens/sec/chip,
+    bf16 fused step.  Baseline: GluonNLP fp16 on V100 ~3000 tok/s/GPU."""
+    _setup_platform()
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.models import bert_base
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    batch = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 2))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 512 if on_tpu else 64))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+
+    mx.random.seed(0)
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    mx.context.Context._default_ctx.value = ctx
+    net = bert_base()
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(logits, labels):
+        return loss_fn(logits.reshape(-1, logits.shape[-1]),
+                       labels.reshape(-1))
+
+    step = DataParallelStep(
+        net, mlm_loss, mesh=local_mesh(devices=[ctx.jax_device]),
+        optimizer="adam", optimizer_params={"learning_rate": 1e-4})
+    V = 30522
+    tokens = np.random.randint(0, V, (batch, seqlen)).astype(np.int32)
+    labels = tokens.astype(np.float32)
+    tb = nd.array(tokens, ctx=ctx, dtype="int32")
+    lb = nd.array(labels, ctx=ctx)
+    loss = step.step(tb, lb)
+    float(np.asarray(loss))
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step.step(tb, lb)
+        float(np.asarray(loss))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    tok_per_sec = batch * seqlen * steps / best_dt
+    baseline = 3000.0  # GluonNLP BERT-base fp16 V100 (BASELINE.md)
+    print(json.dumps({
+        "metric": "bert_base_mlm_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tok_per_sec / baseline, 4),
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
+        bench_bert()
+        return
     _setup_platform()
     import jax
     import numpy as np

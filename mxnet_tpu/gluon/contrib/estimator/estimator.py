@@ -31,15 +31,17 @@ class Estimator:
             metrics = [metrics]
         self.train_metrics = list(metrics)
         self.train_loss_metric = Loss(f"train {type(loss).__name__.lower()}")
-        self.val_metrics = [type(m)() for m in self.train_metrics]
+        # fresh copies with the same configuration (EvalMetric keeps its
+        # ctor kwargs) so val updates don't mix into train state
+        self.val_metrics = [type(m)(**getattr(m, "_kwargs", {}))
+                            for m in self.train_metrics]
         self.val_loss_metric = Loss(f"val {type(loss).__name__.lower()}")
 
         self.context = context or current_context()
         params = self.net.collect_params()
-        try:
-            self.net.initialize(init=initializer, ctx=self.context)
-        except Exception:
-            pass  # already initialized
+        # no-op on already-initialized parameters (initialize only touches
+        # uninitialized params unless force_reinit)
+        self.net.initialize(init=initializer, ctx=self.context)
         self.trainer = trainer or Trainer(params, "adam",
                                           {"learning_rate": 1e-3})
 
@@ -90,7 +92,7 @@ class Estimator:
 
         for h in train_begin:
             h.train_begin(self)
-        stop = False
+        stop = any(h.stop_training for h in stop_handlers)
         while not stop:
             for h in epoch_begin:
                 h.epoch_begin(self)

@@ -55,15 +55,17 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
     def train_begin(self, estimator, *args, **kwargs):
         self.current_batch = 0
         self.current_epoch = 0
+        # a zero budget means "don't train" (e.g. resume-and-evaluate)
+        self.stop_training = self.max_epoch == 0 or self.max_batch == 0
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
-        if self.max_batch and self.current_batch >= self.max_batch:
+        if self.max_batch is not None and self.current_batch >= self.max_batch:
             self.stop_training = True
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
-        if self.max_epoch and self.current_epoch >= self.max_epoch:
+        if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
             self.stop_training = True
 
 
@@ -179,12 +181,17 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
+        self.verbose = verbose
         self.save_best = save_best
         self.epoch_period = epoch_period
         self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.current_epoch = 0
         self.current_batch = 0
         self.best = None
+        self._saved = []  # rolling (non-best) checkpoint prefixes
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
         if mode == "min" or (mode == "auto" and monitor is not None
                              and "loss" in monitor.get()[0]):
             self._improved = lambda new, best: new < best
@@ -195,12 +202,43 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         os.makedirs(self.model_dir, exist_ok=True)
         self.current_epoch = 0
         self.current_batch = 0
+        if self.resume_from_checkpoint:
+            latest = self._latest_checkpoint()
+            if latest is not None:
+                estimator.net.load_parameters(latest + ".params")
+                if (estimator.trainer is not None
+                        and os.path.exists(latest + ".states")):
+                    estimator.trainer.load_states(latest + ".states")
+                if self.verbose:
+                    self.logger.info("resumed from %s", latest)
+
+    def _latest_checkpoint(self):
+        import glob
+
+        cands = glob.glob(os.path.join(
+            self.model_dir, f"{self.model_prefix}-*.params"))
+        cands = [c for c in cands if not c.endswith("-best.params")]
+        if not cands:
+            return None
+        return max(cands, key=os.path.getmtime)[:-len(".params")]
 
     def _save(self, estimator, tag):
         prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
         estimator.net.save_parameters(prefix + ".params")
         if estimator.trainer is not None:
             estimator.trainer.save_states(prefix + ".states")
+        if self.verbose:
+            self.logger.info("saved checkpoint %s", prefix)
+        if tag != "best":
+            self._saved.append(prefix)
+            while (self.max_checkpoints
+                   and len(self._saved) > self.max_checkpoints):
+                old = self._saved.pop(0)
+                for suffix in (".params", ".states"):
+                    try:
+                        os.remove(old + suffix)
+                    except OSError:
+                        pass
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -242,6 +280,8 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         self.wait = 0
         self.stop_training = False
         self.current_epoch = 0
+        self.stopped_epoch = 0
+        self.best = self.baseline  # a second fit() starts fresh
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1

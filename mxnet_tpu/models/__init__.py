@@ -2,5 +2,7 @@
 CNN zoo in gluon.model_zoo.vision)."""
 from . import transformer
 from . import bert
+from . import ssd
 from .bert import BERTModel, BERTForMLM, bert_base, bert_small
+from .ssd import SSD, SSDTrainLoss, ssd_300
 from .transformer import TransformerEncoder, MultiHeadAttention

@@ -307,19 +307,29 @@ class NDArray:
         # gradients to protect).
         from ..base import is_float_dtype
 
-        if (isinstance(other, (int, float, np.generic))
-                and is_float_dtype(self._data.dtype)):
-            return _reg.invoke_by_name("_power_scalar", [self],
-                                       scalar=float(other))
+        if isinstance(other, (int, float, np.generic)):
+            if is_float_dtype(self._data.dtype):
+                return _reg.invoke_by_name("_power_scalar", [self],
+                                           scalar=float(other))
+            if not float(other).is_integer():
+                # int array ** fractional exponent: promote (the _binary
+                # path would truncate the exponent to the int dtype)
+                return _reg.invoke_by_name(
+                    "_power_scalar", [self.astype("float32")],
+                    scalar=float(other))
         return self._binary(other, "broadcast_power")
 
     def __rpow__(self, other):
         from ..base import is_float_dtype
 
-        if (isinstance(other, (int, float, np.generic))
-                and is_float_dtype(self._data.dtype)):
-            return _reg.invoke_by_name("_rpower_scalar", [self],
-                                       scalar=float(other))
+        if isinstance(other, (int, float, np.generic)):
+            if is_float_dtype(self._data.dtype):
+                return _reg.invoke_by_name("_rpower_scalar", [self],
+                                           scalar=float(other))
+            if not float(other).is_integer():
+                return _reg.invoke_by_name(
+                    "_rpower_scalar", [self.astype("float32")],
+                    scalar=float(other))
         return self._binary(other, "broadcast_power", reverse=True)
 
     def __neg__(self):

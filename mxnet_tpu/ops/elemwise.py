@@ -255,3 +255,15 @@ def multi_all_finite(*arrays, num_arrays=1, init_output=True):
     for a in arrays:
         ok = ok & jnp.isfinite(a.astype(jnp.float32)).all()
     return ok.astype(jnp.float32).reshape(1)
+
+
+@register("maximum")
+def maximum(lhs, rhs):
+    """Elementwise max (reference: mx.nd.maximum, broadcasting)."""
+    return jnp.maximum(lhs, rhs)
+
+
+@register("minimum")
+def minimum(lhs, rhs):
+    """Elementwise min (reference: mx.nd.minimum, broadcasting)."""
+    return jnp.minimum(lhs, rhs)

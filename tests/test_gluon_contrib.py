@@ -125,3 +125,50 @@ def test_estimator_early_stopping():
                                    patience=2, min_delta=0.2, mode="min")
     est.fit(_Toy(), epochs=50, event_handlers=[stopper])
     assert stopper.current_epoch < 50  # stopped early
+
+
+def test_int_pow_fractional_promotes():
+    x = nd.array(np.array([9, 4], np.int32), dtype="int32")
+    out = x ** 0.5
+    np.testing.assert_allclose(out.asnumpy(), [3.0, 2.0])
+    out2 = x ** 2
+    assert np.dtype(out2.dtype) == np.int32
+
+
+def test_checkpoint_handler_pruning(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    ckpt = CheckpointHandler(str(tmp_path), max_checkpoints=2)
+    est.fit(_Toy(), epochs=5, event_handlers=[ckpt])
+    import glob
+
+    saved = sorted(glob.glob(str(tmp_path / "model-epoch*.params")))
+    assert len(saved) == 2  # pruned to max_checkpoints
+    assert saved[-1].endswith("epoch5.params")
+
+
+def test_checkpoint_resume(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(_Toy(), epochs=2,
+            event_handlers=[CheckpointHandler(str(tmp_path))])
+    w_trained = net.collect_params()
+    snap = {k: v.data().asnumpy().copy() for k, v in w_trained.items()}
+
+    net2 = gluon.nn.Dense(2)
+    net2.initialize(mx.init.Xavier())
+    est2 = Estimator(net2, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                     metrics=mx.metric.Accuracy())
+    resume = CheckpointHandler(str(tmp_path), resume_from_checkpoint=True)
+    est2.fit(_Toy(), epochs=0, event_handlers=[resume])  # load, train 0
+    for (k, v), (k2, v2) in zip(sorted(snap.items()),
+                                sorted(net2.collect_params().items())):
+        np.testing.assert_allclose(v, v2.data().asnumpy(), rtol=1e-6)

@@ -1,0 +1,78 @@
+"""SSD detector model family: multi-scale head shapes, one-jit train step
+convergence on synthetic boxes, decode+NMS inference.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import SSDTrainLoss, ssd_300
+
+
+def _net(num_classes=2):
+    mx.random.seed(0)
+    net = ssd_300(num_classes=num_classes)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_ssd_forward_shapes():
+    net = _net()
+    x = nd.zeros((2, 3, 128, 128))
+    anchors, cls_preds, box_preds = net(x)
+    N = anchors.shape[1]
+    assert anchors.shape == (1, N, 4)
+    assert cls_preds.shape == (2, N, 3)
+    assert box_preds.shape == (2, N * 4)
+    # anchors normalized
+    a = anchors.asnumpy()
+    assert a.min() > -0.5 and a.max() < 1.5
+
+
+def test_ssd_train_step_decreases_loss():
+    net = _net(num_classes=1)
+    loss_block = SSDTrainLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    # synthetic: one box, class 0, fixed location
+    B = 4
+    x = nd.array(np.random.RandomState(0).rand(B, 3, 96, 96)
+                 .astype(np.float32))
+    labels = nd.array(np.tile(
+        np.array([[0, 0.25, 0.25, 0.75, 0.75]], np.float32), (B, 1, 1)))
+    losses = []
+    for i in range(12):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            loss = loss_block(anchors, cls_preds, box_preds, labels)
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_ssd_detect_output_format():
+    net = _net(num_classes=2)
+    x = nd.zeros((1, 3, 128, 128))
+    out = net.detect(x, threshold=0.0).asnumpy()
+    assert out.ndim == 3 and out.shape[2] == 6
+    ids = out[0, :, 0]
+    # class ids are -1 (suppressed) or within range
+    assert ((ids >= -1) & (ids < 2)).all()
+    valid = ids >= 0
+    scores = out[0, valid, 1]  # suppressed rows are filled with -1
+    assert ((scores >= 0) & (scores <= 1)).all()
+
+
+def test_ssd_hybridize_matches_eager():
+    net = _net()
+    x = nd.array(np.random.RandomState(1).rand(1, 3, 96, 96)
+                 .astype(np.float32))
+    a1, c1, b1 = net(x)
+    net.hybridize()
+    a2, c2, b2 = net(x)
+    np.testing.assert_allclose(c1.asnumpy(), c2.asnumpy(), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(b1.asnumpy(), b2.asnumpy(), rtol=2e-4,
+                               atol=2e-5)
