@@ -31,10 +31,13 @@ class Estimator:
             metrics = [metrics]
         self.train_metrics = list(metrics)
         self.train_loss_metric = Loss(f"train {type(loss).__name__.lower()}")
-        # fresh copies with the same configuration (EvalMetric keeps its
-        # ctor kwargs) so val updates don't mix into train state
-        self.val_metrics = [type(m)(**getattr(m, "_kwargs", {}))
-                            for m in self.train_metrics]
+        # independent deep copies (preserving name/axis/every config) so
+        # val updates don't mix into train state
+        import copy
+
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.reset()
         self.val_loss_metric = Loss(f"val {type(loss).__name__.lower()}")
 
         self.context = context or current_context()

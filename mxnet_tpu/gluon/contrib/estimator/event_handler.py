@@ -199,9 +199,19 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self._improved = lambda new, best: new > best
 
     def train_begin(self, estimator, *args, **kwargs):
+        import glob
+        import re
+
         os.makedirs(self.model_dir, exist_ok=True)
         self.current_epoch = 0
         self.current_batch = 0
+        # adopt pre-existing rolling checkpoints so pruning and epoch
+        # numbering continue across resumed runs instead of restarting
+        existing = sorted(
+            (c for c in glob.glob(os.path.join(
+                self.model_dir, f"{self.model_prefix}-*.params"))
+             if not c.endswith("-best.params")), key=os.path.getmtime)
+        self._saved = [c[:-len(".params")] for c in existing]
         if self.resume_from_checkpoint:
             latest = self._latest_checkpoint()
             if latest is not None:
@@ -209,6 +219,9 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                 if (estimator.trainer is not None
                         and os.path.exists(latest + ".states")):
                     estimator.trainer.load_states(latest + ".states")
+                m = re.search(r"epoch(\d+)$", latest)
+                if m:
+                    self.current_epoch = int(m.group(1))
                 if self.verbose:
                     self.logger.info("resumed from %s", latest)
 

@@ -371,3 +371,125 @@ def linalg_gelqf(a):
     (reference: la_op gelqf via LAPACK)."""
     q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
     return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+@register("split_v2")
+def split_v2(x, indices_or_sections=1, axis=0, squeeze_axis=False,
+             sections=0):
+    """numpy-style split (reference src/operator/tensor/matrix_op.cc
+    _split_v2): int -> equal sections, tuple -> split points."""
+    if sections and sections > 0:
+        spec = int(sections)
+    elif isinstance(indices_or_sections, int):
+        spec = int(indices_or_sections)
+    else:
+        spec = [int(i) for i in indices_or_sections]
+    outs = jnp.split(x, spec, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape (optionally only a dim range each side;
+    reference matrix_op.cc reshape_like)."""
+    lshape = list(lhs.shape)
+    rshape = list(rhs.shape)
+
+    def _resolve(idx, ndim, default):
+        if idx is None:
+            return default
+        idx = int(idx)
+        return idx + ndim if idx < 0 else idx  # MXNet negative-index rule
+
+    lb = _resolve(lhs_begin, len(lshape), 0)
+    le = _resolve(lhs_end, len(lshape), len(lshape))
+    rb = _resolve(rhs_begin, len(rshape), 0)
+    re_ = _resolve(rhs_end, len(rshape), len(rshape))
+    new_shape = lshape[:lb] + rshape[rb:re_] + lshape[le:]
+    return jnp.reshape(lhs, tuple(int(s) for s in new_shape))
+
+
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    out = jnp.cumsum(a if axis is not None else a.reshape(-1),
+                     axis=axis if axis is not None else 0)
+    if dtype is not None:
+        from ..base import dtype_np
+
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register("logsumexp")
+def logsumexp(data, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(
+        data, axis=axis if axis is None else tuple(
+            [axis] if isinstance(axis, int) else axis), keepdims=keepdims)
+
+
+@register("onehot_encode", differentiable=False)
+def onehot_encode(indices, out_like):
+    """Legacy onehot: indices (B,), out shape (B, C) taken from the second
+    input (reference ndarray_function.cc OnehotEncode)."""
+    c = out_like.shape[1]
+    return jax.nn.one_hot(indices.astype(jnp.int32), c,
+                          dtype=out_like.dtype)
+
+
+@register("choose_element_0index", differentiable=False)
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] (legacy; reference ndarray_function.cc)."""
+    idx = rhs.astype(jnp.int32)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register("fill_element_0index", differentiable=False)
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (legacy)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C = alpha * op(A) op(B) + beta * C (reference la_op.cc gemm).
+    `axis` is the position of the matrix-row dimension (the matrix spans
+    (axis, axis+1); batch dims elsewhere)."""
+    axis = int(axis)
+    moved = axis not in (-2, a.ndim - 2)
+    if moved:
+        a = jnp.moveaxis(a, (axis, axis + 1), (-2, -1))
+        b = jnp.moveaxis(b, (axis, axis + 1), (-2, -1))
+        c = jnp.moveaxis(c, (axis, axis + 1), (-2, -1))
+    ta = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    tb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    out = alpha * jnp.matmul(ta, tb) + beta * c
+    if moved:
+        out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+    return out
+
+
+@register("linalg_trmm")
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix product (reference la_op.cc trmm)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * out
+
+
+@register("linalg_potri")
+def linalg_potri(a, lower=True):
+    """Inverse from a Cholesky factor: (A A^T)^-1 given A
+    (reference la_op.cc potri)."""
+    n = a.shape[-1]
+    eye = jnp.eye(n, dtype=a.dtype)
+    inv_a = jax.scipy.linalg.solve_triangular(a, eye, lower=lower)
+    return (jnp.swapaxes(inv_a, -1, -2) @ inv_a if lower
+            else inv_a @ jnp.swapaxes(inv_a, -1, -2))

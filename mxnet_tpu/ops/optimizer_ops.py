@@ -196,3 +196,115 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
     ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
     new_w = weight.astype(jnp.float32) - lr * ratio * g_update
     return new_w.astype(weight.dtype)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor apply variants (reference optimizer_op-inl.h ~L1500
+# MultiSGDUpdate/MultiSGDMomUpdate + preloaded_* forms).  One op call
+# updates many parameters; under jit XLA fuses the whole sweep.
+# ---------------------------------------------------------------------------
+def _norm_list(v, n):
+    vals = [float(x) for x in (v if isinstance(v, (tuple, list)) else [v])]
+    if len(vals) == 1:
+        vals = vals * n
+    return vals
+
+
+@register("multi_sgd_update", differentiable=False)
+def multi_sgd_update(*data, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    """data = [w0, g0, w1, g1, ...]; returns the updated weights."""
+    n = int(num_weights)
+    lrs = _norm_list(lrs, n)
+    wds = _norm_list(wds, n)
+    outs = []
+    for i in range(n):
+        w, g = data[2 * i], data[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs) if n > 1 else outs[0]
+
+
+@register("multi_sgd_mom_update", differentiable=False)
+def multi_sgd_mom_update(*data, lrs=(0.01,), wds=(0.0,), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    """data = [w0, g0, m0, w1, g1, m1, ...]; returns (w_i, m_i) pairs."""
+    n = int(num_weights)
+    lrs = _norm_list(lrs, n)
+    wds = _norm_list(wds, n)
+    outs = []
+    for i in range(n):
+        w, g, m = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        nw, nm = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([nw, nm])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", differentiable=False)
+def multi_mp_sgd_update(*data, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1):
+    """data = [w0, g0, w32_0, ...]; returns (w_i, w32_i) pairs."""
+    n = int(num_weights)
+    lrs = _norm_list(lrs, n)
+    wds = _norm_list(wds, n)
+    outs = []
+    for i in range(n):
+        w, g, w32 = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        nw, n32 = mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([nw, n32])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", differentiable=False)
+def multi_mp_sgd_mom_update(*data, lrs=(0.01,), wds=(0.0,), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1):
+    """data = [w0, g0, m0, w32_0, ...]; returns (w_i, m_i, w32_i) triples."""
+    n = int(num_weights)
+    lrs = _norm_list(lrs, n)
+    wds = _norm_list(wds, n)
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = (data[4 * i], data[4 * i + 1], data[4 * i + 2],
+                        data[4 * i + 3])
+        nw, nm, n32 = mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend([nw, nm, n32])
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_update", differentiable=False)
+def preloaded_multi_sgd_update(*data, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=1):
+    """Like multi_sgd_update but lrs/wds arrive as trailing ARRAYS
+    (reference preloaded_multi_sgd_update: scheduler-computed on device)."""
+    n = int(num_weights)
+    lrs, wds = data[-2], data[-1]
+    outs = []
+    for i in range(n):
+        w, g = data[2 * i], data[2 * i + 1]
+        g2 = _apply_wd_rescale(g, w, rescale_grad, wds[i], clip_gradient)
+        outs.append((w.astype(jnp.float32) - lrs[i] * g2).astype(w.dtype))
+    return tuple(outs) if n > 1 else outs[0]
+
+
+@register("preloaded_multi_sgd_mom_update", differentiable=False)
+def preloaded_multi_sgd_mom_update(*data, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1):
+    n = int(num_weights)
+    lrs, wds = data[-2], data[-1]
+    outs = []
+    for i in range(n):
+        w, g, m = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        g2 = _apply_wd_rescale(g, w, rescale_grad, wds[i], clip_gradient)
+        nm = momentum * m.astype(jnp.float32) - lrs[i] * g2
+        outs.extend([(w.astype(jnp.float32) + nm).astype(w.dtype),
+                     nm.astype(m.dtype)])
+    return tuple(outs)

@@ -111,6 +111,12 @@ def static_num_outputs(op_name: str, attrs: dict) -> int:
     before any evaluation (reference: nnvm FNumOutputs)."""
     if op_name in ("SliceChannel", "split"):
         return int(attrs.get("num_outputs", 1))
+    if op_name == "split_v2":
+        sections = int(attrs.get("sections", 0) or 0)
+        if sections > 0:
+            return sections
+        spec = attrs.get("indices_or_sections", 1)
+        return int(spec) if isinstance(spec, int) else len(spec) + 1
     if op_name in ("moments", "linalg_slogdet", "linalg_gelqf"):
         return 2
     if op_name == "topk" and attrs.get("ret_typ") == "both":

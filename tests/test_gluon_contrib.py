@@ -172,3 +172,25 @@ def test_checkpoint_resume(tmp_path):
     for (k, v), (k2, v2) in zip(sorted(snap.items()),
                                 sorted(net2.collect_params().items())):
         np.testing.assert_allclose(v, v2.data().asnumpy(), rtol=1e-6)
+
+
+def test_checkpoint_resume_continues_numbering(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(_Toy(), epochs=3,
+            event_handlers=[CheckpointHandler(str(tmp_path),
+                                              max_checkpoints=2)])
+    est.fit(_Toy(), epochs=2,
+            event_handlers=[CheckpointHandler(
+                str(tmp_path), max_checkpoints=2,
+                resume_from_checkpoint=True)])
+    import glob
+
+    saved = sorted(glob.glob(str(tmp_path / "model-epoch*.params")))
+    # resumed run continues at epoch4/epoch5 and pruning holds at 2 files
+    assert len(saved) == 2, saved
+    assert saved[-1].endswith("epoch5.params"), saved

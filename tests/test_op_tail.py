@@ -145,3 +145,105 @@ def test_env_vars_map():
     env_vars._warned = False
     env_vars.check({"MXNET_GPU_MEM_POOL_TYPE": "Round",
                     "MXNET_MYSTERY_FLAG": "1"})
+
+
+def test_split_v2_and_reshape_like():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    parts = nd.split_v2(x, 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    parts = nd.split_v2(x, (1, 4), axis=0)
+    assert [p.shape[0] for p in parts] == [1, 3, 2]
+    y = nd.zeros((3, 4))
+    out = nd.reshape_like(x, y)
+    assert out.shape == (3, 4)
+
+
+def test_cumsum_logsumexp():
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.cumsum(nd.array(x), axis=1).asnumpy(),
+                               np.cumsum(x, axis=1), rtol=1e-6)
+    from scipy.special import logsumexp as ref_lse
+    np.testing.assert_allclose(
+        nd.logsumexp(nd.array(x), axis=1).asnumpy(),
+        ref_lse(x, axis=1), rtol=1e-5)
+
+
+def test_legacy_index_ops():
+    lhs = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    rhs = nd.array(np.array([0, 2, 1, 0], np.float32))
+    out = nd.choose_element_0index(lhs, rhs).asnumpy()
+    np.testing.assert_array_equal(out, [0, 5, 7, 9])
+    mhs = nd.array(np.array([-1, -2, -3, -4], np.float32))
+    filled = nd.fill_element_0index(lhs, mhs, rhs).asnumpy()
+    assert filled[0, 0] == -1 and filled[1, 2] == -2
+    oh = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)),
+                          nd.zeros((2, 3))).asnumpy()
+    np.testing.assert_array_equal(oh, [[0, 1, 0], [1, 0, 0]])
+
+
+def test_linalg_gemm_trmm_potri():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 3).astype(np.float32)
+    b = rng.rand(3, 3).astype(np.float32)
+    c = rng.rand(3, 3).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2 * a @ b + 0.5 * c, rtol=1e-5)
+    tri = np.tril(a)
+    out = nd.linalg_trmm(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, tri @ b, rtol=1e-5)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = np.linalg.cholesky(spd)
+    inv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_multi_sgd_and_preloaded():
+    rng = np.random.RandomState(0)
+    ws = [rng.rand(4).astype(np.float32) for _ in range(2)]
+    gs = [rng.rand(4).astype(np.float32) for _ in range(2)]
+    outs = nd.multi_sgd_update(nd.array(ws[0]), nd.array(gs[0]),
+                               nd.array(ws[1]), nd.array(gs[1]),
+                               lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                               num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(outs[1].asnumpy(), ws[1] - 0.2 * gs[1],
+                               rtol=1e-6)
+    lrs = nd.array(np.array([0.1, 0.2], np.float32))
+    wds = nd.array(np.zeros(2, np.float32))
+    outs2 = nd.preloaded_multi_sgd_update(
+        nd.array(ws[0]), nd.array(gs[0]), nd.array(ws[1]), nd.array(gs[1]),
+        lrs, wds, num_weights=2)
+    np.testing.assert_allclose(outs2[0].asnumpy(), outs[0].asnumpy(),
+                               rtol=1e-6)
+    # momentum variant keeps state
+    m = nd.zeros((4,))
+    w2, m2 = nd.multi_sgd_mom_update(nd.array(ws[0]), nd.array(gs[0]), m,
+                                     lrs=(0.1,), wds=(0.0,), momentum=0.9,
+                                     num_weights=1)
+    np.testing.assert_allclose(m2.asnumpy(), -0.1 * gs[0], rtol=1e-6)
+
+
+def test_reshape_like_negative_indices():
+    lhs = nd.zeros((30, 12))
+    rhs = nd.zeros((4, 2, 2, 3))
+    # lhs dims [1:) replaced by rhs dims [1:3): (30, 2, 2) -> wrong size;
+    # use the documented MXNet example: lhs (30,12), rhs (4,2,2,3),
+    # lhs_begin=-1 means dim 1: (30,) + rhs[1:] would not fit, so take
+    # rhs dims (2,2,3) -> (30, 2, 2, 3)? sizes must match: 12 == 2*2*3
+    out = nd.reshape_like(lhs, rhs, lhs_begin=-1, lhs_end=None,
+                          rhs_begin=1, rhs_end=None)
+    assert out.shape == (30, 2, 2, 3)
+
+
+def test_linalg_gemm_axis():
+    rng = np.random.RandomState(0)
+    # batched with matrix axes (0,1), batch axis 2
+    a = rng.rand(3, 4, 5).astype(np.float32)
+    b = rng.rand(4, 2, 5).astype(np.float32)
+    c = rng.rand(3, 2, 5).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         axis=0).asnumpy()
+    expect = np.einsum("ikb,kjb->ijb", a, b) + c
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
