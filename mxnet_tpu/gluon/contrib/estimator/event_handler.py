@@ -205,23 +205,28 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         os.makedirs(self.model_dir, exist_ok=True)
         self.current_epoch = 0
         self.current_batch = 0
-        # adopt pre-existing rolling checkpoints so pruning and epoch
-        # numbering continue across resumed runs instead of restarting
-        existing = sorted(
-            (c for c in glob.glob(os.path.join(
-                self.model_dir, f"{self.model_prefix}-*.params"))
-             if not c.endswith("-best.params")), key=os.path.getmtime)
-        self._saved = [c[:-len(".params")] for c in existing]
+        self._saved = []
         if self.resume_from_checkpoint:
+            # adopt pre-existing rolling checkpoints so pruning and epoch
+            # numbering continue instead of restarting (a fresh run in the
+            # same dir must NOT adopt: pruning would delete its own saves)
+            existing = sorted(
+                (c for c in glob.glob(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-*.params"))
+                 if not c.endswith("-best.params")), key=os.path.getmtime)
+            self._saved = [c[:-len(".params")] for c in existing]
             latest = self._latest_checkpoint()
             if latest is not None:
                 estimator.net.load_parameters(latest + ".params")
                 if (estimator.trainer is not None
                         and os.path.exists(latest + ".states")):
                     estimator.trainer.load_states(latest + ".states")
-                m = re.search(r"epoch(\d+)$", latest)
-                if m:
-                    self.current_epoch = int(m.group(1))
+                # continue epoch numbering from the highest epoch tag on
+                # disk (the latest file may be a batch-period checkpoint)
+                epochs = [int(m.group(1)) for c in self._saved
+                          for m in [re.search(r"epoch(\d+)$", c)] if m]
+                if epochs:
+                    self.current_epoch = max(epochs)
                 if self.verbose:
                     self.logger.info("resumed from %s", latest)
 

@@ -2,9 +2,11 @@
 imresize, augmenters, ImageIter; backed by src/operator/image/ ops) and the
 detection pipeline (python/mxnet/image/detection.py — ImageDetIter)."""
 from .image import (imdecode, imencode, imread, imresize, resize_short,
-                    fixed_crop, center_crop, random_crop, color_normalize,
+                    fixed_crop, center_crop, random_crop, random_size_crop,
+                    color_normalize,
                     CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
-                    RandomCropAug, CenterCropAug, HorizontalFlipAug,
+                    RandomCropAug, CenterCropAug, RandomSizedCropAug,
+                    RandomOrderAug, HorizontalFlipAug,
                     CastAug, BrightnessJitterAug, ContrastJitterAug,
                     SaturationJitterAug, HueJitterAug, ColorJitterAug,
                     LightingAug, RandomGrayAug, ColorNormalizeAug, ImageIter)

@@ -119,6 +119,29 @@ def random_crop(src, size, interp=2):
         (x0, y0, new_w, new_h)
 
 
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop with size/aspect jitter then resize (reference:
+    image.py random_size_crop — the Inception/ResNet train crop)."""
+    import math
+
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+        new_ratio = math.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(math.sqrt(target_area * new_ratio)))
+        new_h = int(round(math.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
 def color_normalize(src, mean, std=None):
     src = src - mean
     if std is not None:
@@ -177,6 +200,43 @@ class CenterCropAug(Augmenter):
 
     def __call__(self, src):
         return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random size + aspect crop (reference RandomSizedCropAug)."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        """Embed child dumps (reference overrides dumps the same way)."""
+        import json
+
+        return json.dumps(["RandomOrderAug",
+                           [json.loads(t.dumps()) for t in self.ts]])
+
+    def __call__(self, src):
+        order = list(self.ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
 
 
 class HorizontalFlipAug(Augmenter):
@@ -333,7 +393,11 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        # Inception-style random area+aspect crop (implies rand_crop)
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))

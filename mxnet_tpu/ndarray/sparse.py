@@ -25,7 +25,10 @@ from ..context import Context, current_context
 from .ndarray import NDArray, array as _dense_array
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
-           "row_sparse_array", "csr_matrix", "zeros", "array", "empty"]
+           "row_sparse_array", "csr_matrix", "zeros", "array", "empty",
+           "retain", "dot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+           "elemwise_div", "add", "subtract", "multiply", "divide",
+           "zeros_like"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -332,3 +335,93 @@ def _component(x, dtype):
     if dtype is not None:
         arr = arr.astype(dtype_np(dtype) if dtype != "int64" else np.int64)
     return arr
+
+
+# ---------------------------------------------------------------------------
+# functional namespace (reference: python/mxnet/ndarray/sparse.py module
+# functions — mx.nd.sparse.dot/retain/elemwise_* etc.)
+# ---------------------------------------------------------------------------
+def retain(data, indices):
+    """Keep only the given rows of a row_sparse array
+    (reference sparse.retain)."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return data.retain(indices)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr x dense uses the compressed rows directly;
+    every other combination contracts densely (reference sparse dot.cc)."""
+    if (isinstance(lhs, CSRNDArray) and not transpose_b
+            and not isinstance(rhs, BaseSparseNDArray)):
+        return lhs.dot(rhs, transpose_a=transpose_a)
+    from .. import ndarray as nd
+
+    a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return nd.dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def _rs_binary(lhs, rhs, dense_op):
+    """row_sparse (+|-) row_sparse stays sparse via index union; any other
+    combination falls back to the dense op (reference FComputeEx fallback
+    semantics)."""
+    import jax.numpy as jnp
+
+    if (isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray)
+            and lhs.shape == rhs.shape and dense_op in ("add", "sub")):
+        sign = 1.0 if dense_op == "add" else -1.0
+        idx = jnp.concatenate([lhs._aux["indices"], rhs._aux["indices"]])
+        vals = jnp.concatenate([lhs._data,
+                                sign * rhs._data.astype(lhs._data.dtype)])
+        uids, summed = aggregate_rows(idx, vals)
+        return RowSparseNDArray(summed.astype(lhs._data.dtype),
+                                {"indices": uids}, lhs.shape, ctx=lhs._ctx)
+    from .. import ndarray as nd
+
+    a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return getattr(nd, f"elemwise_{dense_op}")(a, b)
+
+
+def elemwise_add(lhs, rhs):
+    return _rs_binary(lhs, rhs, "add")
+
+
+def elemwise_sub(lhs, rhs):
+    return _rs_binary(lhs, rhs, "sub")
+
+
+def elemwise_mul(lhs, rhs):
+    return _rs_binary(lhs, rhs, "mul")
+
+
+def elemwise_div(lhs, rhs):
+    return _rs_binary(lhs, rhs, "div")
+
+
+add = elemwise_add
+subtract = elemwise_sub
+multiply = elemwise_mul
+divide = elemwise_div
+
+
+def zeros_like(data):
+    import jax.numpy as jnp
+
+    if isinstance(data, RowSparseNDArray):
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(data.shape[1:]), data._data.dtype),
+            {"indices": jnp.zeros((0,), data._aux["indices"].dtype)},
+            data.shape, ctx=data._ctx)
+    if isinstance(data, CSRNDArray):
+        # empty-component csr: stype is preserved, nothing densifies
+        return CSRNDArray(
+            jnp.zeros((0,), data._data.dtype),
+            {"indices": jnp.zeros((0,), data._aux["indices"].dtype),
+             "indptr": jnp.zeros((data.shape[0] + 1,),
+                                 data._aux["indptr"].dtype)},
+            data.shape, ctx=data._ctx)
+    from .. import ndarray as nd
+
+    return nd.zeros_like(data)

@@ -1,0 +1,156 @@
+"""mx.nd.sparse functional namespace + new image augmenters."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rs(dense):
+    return nd.array(dense).tostype("row_sparse")
+
+
+def test_sparse_elemwise_add_stays_sparse():
+    a = np.zeros((6, 3), np.float32)
+    a[1] = 1
+    a[4] = 2
+    b = np.zeros((6, 3), np.float32)
+    b[1] = 10
+    b[2] = 5
+    out = sparse.add(_rs(a), _rs(b))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.asnumpy(), a + b)
+    out = sparse.subtract(_rs(a), _rs(b))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.asnumpy(), a - b)
+    # mul falls back dense
+    out = sparse.multiply(_rs(a), _rs(b))
+    np.testing.assert_array_equal(out.asnumpy(), a * b)
+
+
+def test_sparse_dot_csr():
+    a = np.zeros((4, 5), np.float32)
+    a[0, 1] = 2
+    a[3, 4] = 7
+    b = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+    csr = nd.array(a).tostype("csr")
+    out = sparse.dot(csr, nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_sparse_retain_and_zeros_like():
+    a = np.zeros((5, 2), np.float32)
+    a[1] = 3
+    a[3] = 4
+    rs = _rs(a)
+    kept = sparse.retain(rs, nd.array(np.array([1, 2], np.float32)))
+    expect = np.zeros_like(a)
+    expect[1] = 3
+    np.testing.assert_array_equal(kept.asnumpy(), expect)
+    z = sparse.zeros_like(rs)
+    assert z.stype == "row_sparse" and z.shape == (5, 2)
+    assert z.asnumpy().sum() == 0
+
+
+def test_random_sized_crop_aug():
+    from mxnet_tpu import image
+
+    src = np.random.RandomState(0).randint(
+        0, 255, (64, 80, 3)).astype(np.uint8)
+    aug = image.RandomSizedCropAug((32, 32), (0.5, 1.0), (0.75, 1.333))
+    out = aug(nd.array(src.astype(np.float32)))
+    assert out.shape == (32, 32, 3)
+    crop, region = image.random_size_crop(
+        nd.array(src.astype(np.float32)), (24, 24), (0.3, 1.0),
+        (0.8, 1.25))
+    assert crop.shape == (24, 24, 3)
+    x0, y0, w, h = region
+    assert 0 <= x0 <= 80 - w and 0 <= y0 <= 64 - h
+
+
+def test_random_order_aug_and_create_augmenter_rand_resize():
+    from mxnet_tpu import image
+
+    calls = []
+
+    class Tag(image.Augmenter):
+        def __init__(self, tag):
+            super().__init__()
+            self.tag = tag
+
+        def __call__(self, src):
+            calls.append(self.tag)
+            return src
+
+    aug = image.RandomOrderAug([Tag(1), Tag(2), Tag(3)])
+    aug(nd.zeros((4, 4, 3)))
+    assert sorted(calls) == [1, 2, 3]
+    augs = image.CreateAugmenter((3, 32, 32), rand_resize=True,
+                                 rand_mirror=True)
+    assert any(isinstance(a, image.RandomSizedCropAug) for a in augs)
+    src = nd.array(np.random.RandomState(1).rand(50, 60, 3)
+                         .astype(np.float32) * 255)
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (32, 32, 3)
+
+
+def test_sparse_dot_transpose_b_and_sparse_rhs():
+    a = np.zeros((4, 5), np.float32)
+    a[0, 1] = 2
+    a[3, 4] = 7
+    b = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+    csr = nd.array(a).tostype("csr")
+    out = sparse.dot(csr, nd.array(b), transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out, a @ b.T, rtol=1e-5)
+    # sparse rhs densifies, not garbage
+    rs = _rs(np.eye(5, 2, dtype=np.float32))
+    out = sparse.dot(csr, rs).asnumpy()
+    np.testing.assert_allclose(out, a @ np.eye(5, 2), rtol=1e-5)
+
+
+def test_sparse_zeros_like_csr_keeps_stype():
+    a = np.zeros((3, 4), np.float32)
+    a[1, 2] = 5
+    csr = nd.array(a).tostype("csr")
+    z = sparse.zeros_like(csr)
+    assert z.stype == "csr" and z.shape == (3, 4)
+    assert z.asnumpy().sum() == 0
+
+
+def test_random_order_aug_dumps_children():
+    import json
+
+    from mxnet_tpu import image
+
+    aug = image.RandomOrderAug([image.CastAug(), image.HorizontalFlipAug(0.5)])
+    payload = json.loads(aug.dumps())
+    assert payload[0] == "RandomOrderAug"
+    assert [c[0] for c in payload[1]] == ["CastAug", "HorizontalFlipAug"]
+
+
+def test_checkpoint_fresh_run_same_dir_not_pruned(tmp_path):
+    import glob
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler, Estimator
+
+    def run():
+        net = gluon.nn.Dense(2)
+        net.initialize(mx.init.Xavier())
+        est = Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=mx.metric.Accuracy())
+        rng = np.random.RandomState(0)
+        data = [(nd.array(rng.randn(8, 4).astype(np.float32)),
+                 nd.array((rng.rand(8) > 0.5).astype(np.float32)))]
+        est.fit(iter(data), epochs=2,
+                event_handlers=[CheckpointHandler(str(tmp_path),
+                                                  max_checkpoints=5)])
+
+    run()
+    run()  # fresh run in the same dir must not delete its own saves
+    saved = glob.glob(str(tmp_path / "model-epoch*.params"))
+    assert len(saved) == 2, saved  # epoch1, epoch2 overwritten in place
