@@ -370,10 +370,13 @@ def _rs_binary(lhs, rhs, dense_op):
 
     if (isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray)
             and lhs.shape == rhs.shape and dense_op in ("add", "sub")):
-        sign = 1.0 if dense_op == "add" else -1.0
+        # negate in the native dtype: a python-float multiply would promote
+        # int row values to f32 and lose precision above 2^24
+        rvals = rhs._data.astype(lhs._data.dtype)
+        if dense_op == "sub":
+            rvals = -rvals
         idx = jnp.concatenate([lhs._aux["indices"], rhs._aux["indices"]])
-        vals = jnp.concatenate([lhs._data,
-                                sign * rhs._data.astype(lhs._data.dtype)])
+        vals = jnp.concatenate([lhs._data, rvals])
         uids, summed = aggregate_rows(idx, vals)
         return RowSparseNDArray(summed.astype(lhs._data.dtype),
                                 {"indices": uids}, lhs.shape, ctx=lhs._ctx)

@@ -6,6 +6,7 @@ On TPU each op is a pure jax function; XLA performs the kernel fusion that
 mshadow expression templates / FusedOp RTC do in the reference.
 """
 from .registry import Operator, register, get_op, invoke, list_ops
+from . import params  # noqa: F401  (typed param descriptors)
 
 from . import elemwise  # noqa: F401
 from . import creation  # noqa: F401

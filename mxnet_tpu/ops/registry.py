@@ -45,6 +45,11 @@ class Operator:
         key = canonical_kwargs(attrs)
         jfn = self._jit_cache.get(key)
         if jfn is None:
+            # first sight of this attr combo: typed validation (reference
+            # dmlc::Parameter::Init at op instantiation); cache hits skip it
+            from . import params as _params
+
+            _params.validate_known(self.name, attrs)
             fn = self.fn
 
             @functools.wraps(fn)
@@ -131,6 +136,11 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
 
     traced = any(_is_tracer(a) for a in arrays)
     if traced:
+        # hybridized trace: same typed validation as the eager jit-miss
+        # path (once per trace, not per step)
+        from . import params as _params
+
+        _params.validate_known(op.name, attrs)
         arrays = _stop_detached(arrays, inputs)
         outs = op.fn(*arrays, **attrs)
     elif not arrays:

@@ -247,3 +247,66 @@ def test_linalg_gemm_axis():
                          axis=0).asnumpy()
     expect = np.einsum("ikb,kjb->ijb", a, b) + c
     np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_param_struct_describe_and_validate():
+    from mxnet_tpu.ops import params
+
+    table = params.describe("Pooling")
+    assert "pool_type" in table and "max" in table
+    # validate coerces and range-checks
+    out = params.validate("Dropout", {"p": "0.3"})
+    assert out["p"] == 0.3
+    with pytest.raises(mx.base.MXNetError):
+        params.validate("Dropout", {"p": 1.5})  # above upper bound
+    with pytest.raises(mx.base.MXNetError):
+        params.validate("Pooling", {"pool_type": "mean"})  # not in enum
+    with pytest.raises(mx.base.MXNetError):
+        params.validate("Pooling", {"bogus": 1})  # unknown key
+    # every registered op can render its table (signature-derived)
+    from mxnet_tpu.ops.registry import list_ops
+
+    for name in list_ops():
+        params.describe(name)
+
+
+def test_param_validation_on_dispatch():
+    # bad enum value rejected at first dispatch (jit-cache miss)
+    with pytest.raises(mx.base.MXNetError):
+        nd.Pooling(nd.zeros((1, 1, 4, 4)), kernel=(2, 2),
+                   pool_type="mean")
+    from mxnet_tpu import autograd
+
+    with pytest.raises(mx.base.MXNetError):
+        with autograd.record(train_mode=True):  # inference skips the op
+            nd.Dropout(nd.zeros((4,)), p=2.0)
+
+
+def test_param_check_string_coercions():
+    from mxnet_tpu.ops.params import ParamField
+
+    assert ParamField("b", "bool").check("false") is False
+    assert ParamField("b", "bool").check("true") is True
+    assert ParamField("t", "tuple").check("(2, 2)") == (2, 2)
+    with pytest.raises(mx.base.MXNetError):
+        ParamField("b", "bool").check("maybe")
+    # describe() prints the name once per line
+    from mxnet_tpu.ops import params
+
+    line = [l for l in params.describe("Pooling").splitlines()
+            if "pool_type" in l][0]
+    assert line.count("pool_type") == 1
+
+
+def test_param_validation_inside_hybridized_block():
+    from mxnet_tpu import gluon
+
+    class Bad(gluon.nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Pooling(x, kernel=(2, 2), pool_type="mean")
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(mx.base.MXNetError):
+        net(nd.zeros((1, 1, 4, 4)))
