@@ -34,6 +34,8 @@ def main():
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     args = ap.parse_args()
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
 
     shape = tuple(int(s) for s in args.image_shape.split(","))
     mx.random.seed(0)

@@ -69,9 +69,10 @@ def main():
     metric = mx.metric.Accuracy()
 
     B = args.batch_size
+    shuffle_rng = np.random.RandomState(42)  # reproducible convergence smoke
     for epoch in range(args.epochs):
         metric.reset()
-        perm = np.random.permutation(len(X))
+        perm = shuffle_rng.permutation(len(X))
         for i in range(0, len(X) - B + 1, B):
             idx = perm[i:i + B]
             data = nd.array(X[idx], ctx=ctx)

@@ -223,19 +223,19 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                     estimator.trainer.load_states(latest + ".states")
                 # continue epoch numbering from the LOADED checkpoint's
                 # tag; if the newest file is a batch-period checkpoint,
-                # use the highest epoch tag no newer than it (stale
-                # higher-epoch files from an older run must not win)
+                # use the MOST RECENTLY WRITTEN epoch tag (mtime order, not
+                # max number: stale higher-epoch files from an older run
+                # must not win)
                 m = re.search(r"epoch(\d+)$", latest)
                 if m:
                     self.current_epoch = int(m.group(1))
                 else:
-                    cutoff = os.path.getmtime(latest + ".params")
-                    epochs = [
-                        int(em.group(1)) for c in self._saved
-                        for em in [re.search(r"epoch(\d+)$", c)]
-                        if em and os.path.getmtime(c + ".params") <= cutoff]
-                    if epochs:
-                        self.current_epoch = max(epochs)
+                    stamped = [
+                        (os.path.getmtime(c + ".params"), int(em.group(1)))
+                        for c in self._saved
+                        for em in [re.search(r"epoch(\d+)$", c)] if em]
+                    if stamped:
+                        self.current_epoch = max(stamped)[1]
                 if self.verbose:
                     self.logger.info("resumed from %s", latest)
 

@@ -371,12 +371,16 @@ def _rs_binary(lhs, rhs, dense_op):
     if (isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray)
             and lhs.shape == rhs.shape and dense_op in ("add", "sub")):
         # negate in the native dtype: a python-float multiply would promote
-        # int row values to f32 and lose precision above 2^24
-        rvals = rhs._data.astype(lhs._data.dtype)
+        # int row values to f32 and lose precision above 2^24.  Bool has no
+        # unary negative — do its arithmetic in int8 and cast back.
+        dt = lhs._data.dtype
+        work = jnp.int8 if dt == jnp.bool_ else dt
+        lvals = lhs._data.astype(work)
+        rvals = rhs._data.astype(work)
         if dense_op == "sub":
             rvals = -rvals
         idx = jnp.concatenate([lhs._aux["indices"], rhs._aux["indices"]])
-        vals = jnp.concatenate([lhs._data, rvals])
+        vals = jnp.concatenate([lvals, rvals])
         uids, summed = aggregate_rows(idx, vals)
         return RowSparseNDArray(summed.astype(lhs._data.dtype),
                                 {"indices": uids}, lhs.shape, ctx=lhs._ctx)
