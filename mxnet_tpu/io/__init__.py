@@ -5,5 +5,6 @@ The C++ RecordIO image pipeline (ImageRecordIter) plugs in via
 mxnet_tpu.io.image_iter once the native extension is built; NDArrayIter and
 CSVIter are pure Python/jax.
 """
-from .io import DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter, CSVIter
+from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter,
+                 CSVIter, LibSVMIter, PrefetchingIter)
 from .image_iter import ImageRecordIter

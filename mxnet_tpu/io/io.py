@@ -9,7 +9,7 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "CSVIter"]
+           "CSVIter", "LibSVMIter", "PrefetchingIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -270,3 +270,187 @@ class CSVIter(DataIter):
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """libsvm-format iterator yielding csr batches (reference:
+    src/io/iter_libsvm.cc).  Rows are kept as (indices, values) pairs —
+    only one batch is ever densified (batch_size x n_feat), so huge
+    feature spaces don't blow up host memory.  1-based index files
+    (liblinear/svmlight convention) are detected when the max index
+    equals n_feat (it would be out of range 0-based) and shifted.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._n_feat = int(np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                pairs = [p.split(":") for p in parts[1:]]
+                rows.append((np.array([int(k) for k, _ in pairs], np.int64),
+                             np.array([float(v) for _, v in pairs],
+                                      np.float32)))
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.strip():
+                        labels.append(float(line.split()[0]))
+        max_idx = max((int(i.max()) for i, _ in rows if i.size), default=0)
+        min_idx = min((int(i.min()) for i, _ in rows if i.size), default=0)
+        if max_idx >= self._n_feat:
+            if min_idx >= 1 and max_idx == self._n_feat:
+                rows = [(i - 1, v) for i, v in rows]  # 1-based file
+            else:
+                raise MXNetError(
+                    f"libsvm feature index {max_idx} out of range for "
+                    f"data_shape {data_shape}")
+        self._rows = rows
+        self._labels = np.asarray(labels, np.float32)
+        self._round = round_batch
+        self._pos = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(name="data",
+                         shape=(self.batch_size, self._n_feat))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(name="softmax_label", shape=(self.batch_size,))]
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self):
+        from ..ndarray import array as nd_array
+
+        n = len(self._rows)
+        if self._pos >= n:
+            raise StopIteration
+        idxs = list(range(self._pos, min(self._pos + self.batch_size, n)))
+        pad = self.batch_size - len(idxs)
+        if pad:
+            if not self._round:
+                raise StopIteration
+            idxs += list(range(pad))  # wrap-around, reference round_batch
+        self._pos += self.batch_size
+        dense = np.zeros((self.batch_size, self._n_feat), np.float32)
+        for r, j in enumerate(idxs):
+            ci, cv = self._rows[j]
+            dense[r, ci] = cv
+        label = self._labels[idxs]
+        csr = nd_array(dense).tostype("csr")
+        return DataBatch([csr], [nd_array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        try:
+            self.current_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (reference: io.py
+    PrefetchingIter over threadediter) — overlaps host-side batch prep
+    with device compute, the python analog of the C++ PrefetcherIter.
+
+    rename_data/rename_label: list with one dict mapping original
+    descriptor names to new names (reference semantics for binding under
+    different arg names).
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter here wraps exactly one iter; "
+                             "compose multiple with a zip-style wrapper")
+        self._iter = iters[0]
+        super().__init__(getattr(self._iter, "batch_size", 0))
+        self._rename_data = (rename_data[0] if rename_data else None)
+        self._rename_label = (rename_label[0] if rename_label else None)
+        import queue
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._stop = False
+        self._done = False
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def worker():
+            while not self._stop:
+                try:
+                    batch = self._iter.next()
+                except StopIteration:
+                    self._queue.put(("done", None))
+                    return
+                except Exception as exc:  # propagate to the consumer
+                    self._queue.put(("error", exc))
+                    return
+                self._queue.put(("batch", batch))
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        # drain: let the worker finish, clear the queue, restart
+        self._stop = True
+        while self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.1)
+            except Exception:
+                pass
+        self._thread.join()
+        self._iter.reset()
+        self._stop = False
+        self._done = False
+        import queue
+
+        self._queue = queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        if self._done:
+            raise StopIteration  # repeatable after exhaustion
+        kind, payload = self._queue.get()
+        if kind == "done":
+            self._done = True
+            raise StopIteration
+        if kind == "error":
+            self._done = True
+            raise payload
+        return payload
+
+    def iter_next(self):
+        try:
+            self.current_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def _renamed(self, descs, mapping):
+        if not mapping:
+            return descs
+        return [DataDesc(name=mapping.get(d.name, d.name), shape=d.shape)
+                for d in descs]
+
+    @property
+    def provide_data(self):
+        return self._renamed(self._iter.provide_data, self._rename_data)
+
+    @property
+    def provide_label(self):
+        return self._renamed(self._iter.provide_label, self._rename_label)

@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+import mxnet_tpu as mx
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -165,3 +167,80 @@ def test_im2rec_roundtrip(tmp_path):
         labels.extend(b.label[0].asnumpy().ravel().tolist())
     assert len(labels) == 8
     assert sorted(set(labels)) == [0.0, 1.0]
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:2.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    batches = []
+    for b in it:
+        assert b.data[0].stype == "csr"
+        batches.append((b.data[0].asnumpy(), b.label[0].asnumpy()))
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0][0][0], [1.5, 0, 0, 2.0])
+    np.testing.assert_allclose(batches[0][1], [1, 0])
+
+
+def test_prefetching_iter(tmp_path):
+    data = np.arange(24, dtype=np.float32).reshape(6, 4)
+    label = np.arange(6, dtype=np.float32)
+    base = mx.io.NDArrayIter(data, label, batch_size=2)
+    it = mx.io.PrefetchingIter(base)
+    seen = []
+    for b in it:
+        seen.append(b.data[0].asnumpy().copy())
+    assert len(seen) == 3
+    np.testing.assert_allclose(np.concatenate(seen), data)
+    it.reset()
+    again = sum(1 for _ in it)
+    assert again == 3
+
+
+def test_libsvm_iter_one_based_detection(tmp_path):
+    # liblinear convention: indices 1..n_feat
+    p = tmp_path / "one.libsvm"
+    p.write_text("1 1:1.5 4:2.0\n0 2:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy()[0], [1.5, 0, 0, 2.0])
+    # out-of-range index raises instead of shifting silently
+    p2 = tmp_path / "bad.libsvm"
+    p2.write_text("1 0:1.0 7:2.0\n")
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.LibSVMIter(data_libsvm=str(p2), data_shape=(4,), batch_size=1)
+
+
+def test_prefetching_iter_error_and_exhaustion():
+    class Boom(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=1)
+            self.n = 0
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n == 2:
+                raise ValueError("boom")
+            if self.n > 2:
+                raise StopIteration
+            from mxnet_tpu import nd
+
+            return mx.io.DataBatch([nd.zeros((1, 2))], [nd.zeros((1,))])
+
+    it = mx.io.PrefetchingIter(Boom())
+    it.next()
+    with pytest.raises(ValueError):
+        it.next()
+    # exhausted: StopIteration is repeatable, no deadlock
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+    # rename mapping applies to descriptors
+    base = mx.io.NDArrayIter(np.zeros((4, 2), np.float32),
+                             np.zeros(4, np.float32), batch_size=2)
+    it2 = mx.io.PrefetchingIter(base, rename_data=[{"data": "x"}])
+    assert it2.provide_data[0].name == "x"
