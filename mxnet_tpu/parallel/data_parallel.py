@@ -258,8 +258,27 @@ class DataParallelStep:
             self._build()
         data_arr = data._data if isinstance(data, NDArray) else data
         label_arr = label._data if isinstance(label, NDArray) else label
-        dsh = shard_batch(self.mesh, self._batch_axes, np.ndim(data_arr))
-        lsh = shard_batch(self.mesh, self._batch_axes, np.ndim(label_arr))
+        # with an active 'sp' axis, shard the sequence dim (1) over it:
+        # true sequence parallelism — GSPMD emits the cross-device
+        # collectives for attention over the sharded T axis
+        sp_active = (
+            "sp" in self.mesh.axis_names
+            and self.mesh.shape["sp"] > 1
+            and "sp" in self._batch_axes
+        )
+        if sp_active and np.ndim(data_arr) >= 2:
+            from .sharding import shard_batch_seq
+
+            dsh = shard_batch_seq(self.mesh, np.ndim(data_arr))
+            lsh = (shard_batch_seq(self.mesh, np.ndim(label_arr))
+                   if np.ndim(label_arr) >= 2
+                   else shard_batch(self.mesh, ("dp",),
+                                    np.ndim(label_arr)))
+        else:
+            dsh = shard_batch(self.mesh, self._batch_axes,
+                              np.ndim(data_arr))
+            lsh = shard_batch(self.mesh, self._batch_axes,
+                              np.ndim(label_arr))
         data_arr = jax.device_put(data_arr, dsh)
         label_arr = jax.device_put(label_arr, lsh)
         key = _random.next_key()

@@ -64,3 +64,14 @@ def shard_batch(mesh, axes=("dp",), ndim=2):
     axis = tuple(a for a in axes if a in mesh.axis_names)
     spec = (axis if len(axis) > 1 else (axis[0] if axis else None),)
     return NamedSharding(mesh, _P(*spec, *([None] * (ndim - 1))))
+
+
+def shard_batch_seq(mesh, ndim=2):
+    """Sequence-parallel batch sharding: dim 0 over 'dp', dim 1 (sequence)
+    over 'sp'.  Under pjit, GSPMD inserts the cross-device collectives the
+    sequence-sharded activations need (attention over the T axis etc.) —
+    the compiled analog of the reference-era all-to-all SP schemes."""
+    from jax.sharding import NamedSharding
+
+    assert ndim >= 2
+    return NamedSharding(mesh, _P("dp", "sp", *([None] * (ndim - 2))))
