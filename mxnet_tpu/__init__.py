@@ -45,6 +45,8 @@ from . import callback
 from . import model
 from . import operator
 from . import rnn
+from . import monitor
+from .monitor import Monitor
 from . import profiler
 from . import runtime
 from . import util

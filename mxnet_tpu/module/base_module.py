@@ -140,14 +140,20 @@ class BaseModule:
             eval_metric = _metric.create(eval_metric)
         if validation_metric is None:
             validation_metric = eval_metric
+        if monitor is not None:
+            self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = BatchEndParam(epoch=epoch, nbatch=nbatch,

@@ -45,6 +45,13 @@ class BucketingModule(BaseModule):
                       logger=self.logger, context=self._context,
                       fixed_param_names=self._fixed_param_names)
 
+    def install_monitor(self, mon) -> None:
+        """Watch every bucket's executor (reference: BucketingModule
+        installs on all executor groups)."""
+        self._monitor = mon
+        for m in self._buckets.values():
+            m.install_monitor(mon)
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -72,6 +79,8 @@ class BucketingModule(BaseModule):
             mod._updater = default_mod._updater
             mod._optimizer = default_mod._optimizer
             mod.optimizer_initialized = default_mod.optimizer_initialized
+            if getattr(self, "_monitor", None) is not None:
+                mod.install_monitor(self._monitor)  # lazily created bucket
             self._buckets[bucket_key] = mod
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key

@@ -88,6 +88,14 @@ class Module(BaseModule):
         self.binded = True
 
     # -- parameters --------------------------------------------------------
+    def install_monitor(self, mon) -> None:
+        """Watch this module's executor arrays (reference: install per-op
+        output callbacks; here the observable arg/grad/aux/output arrays —
+        see mxnet_tpu/monitor.py docstring)."""
+        if self._exec is None:
+            raise MXNetError("bind() before install_monitor")
+        mon.install(self._exec)
+
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:

@@ -1,0 +1,90 @@
+"""Training monitor (reference: python/mxnet/monitor.py Monitor ~L1-150):
+periodic statistics over watched arrays for debugging divergence/NaNs.
+
+TPU-native scope: the reference registers a per-op output callback inside
+the engine; here whole graphs are single XLA executables, so intermediate
+op outputs are fused away.  The monitor therefore watches the executor's
+OBSERVABLE arrays — arguments (params), gradients, aux states and outputs
+— which is where NaN/explosion debugging lands in practice; per-op
+visibility is available by running eager (MXNET_ENGINE_TYPE=NaiveEngine)
+or via mx.profiler.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Watch arrays matching `pattern` every `interval` batches."""
+
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(1, int(interval))
+        if stat_func is None:
+            def stat_func(arr):
+                import numpy as np
+
+                a = np.abs(arr)
+                return float(a.mean())  # reference default: mean |x|
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.exes: List = []
+        self.queue: List[Tuple[int, str, str]] = []
+        self.logger = logging.getLogger("mxnet_tpu.monitor")
+
+    def install(self, exe) -> None:
+        """Watch an Executor's arg/grad/aux/output arrays (idempotent;
+        a repeated fit() re-installs without duplicating)."""
+        if not any(e is exe for e in self.exes):
+            self.exes.append(exe)
+
+    # ------------------------------------------------------------------
+    def tic(self) -> None:
+        """Start collection for this batch when the interval hits."""
+        self.activated = (self.step % self.interval == 0)
+        self.step += 1
+
+    def _collect(self, name, nd_arr):
+        if not self.re_pattern.match(name):
+            return
+        import numpy as np
+
+        arr = np.asarray(nd_arr.asnumpy())
+        try:
+            stat = self.stat_func(arr)
+        except Exception as exc:  # a bad stat fn shouldn't kill training
+            stat = f"<stat error: {exc}>"
+        self.queue.append((self.step - 1, name, str(stat)))
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Collect stats from installed executors; returns (step, name,
+        stat) triples and clears the queue."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, arr in getattr(exe, "arg_dict", {}).items():
+                self._collect(name, arr)
+            for name, arr in (getattr(exe, "grad_dict", {}) or {}).items():
+                if arr is not None:
+                    self._collect(name + "_grad", arr)
+            for name, arr in getattr(exe, "aux_dict", {}).items():
+                self._collect(name, arr)
+            for i, out in enumerate(getattr(exe, "outputs", []) or []):
+                self._collect(f"output{i}", out)
+        self.activated = False
+        res = self.queue
+        if self.sort:
+            res = sorted(res, key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self) -> None:
+        for step, name, stat in self.toc():
+            self.logger.info("Batch: %7d %30s %s", step, name, stat)

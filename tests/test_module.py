@@ -147,3 +147,75 @@ def test_module_input_grads():
     g = mod._exec.grad_dict["fc_weight"].asnumpy()
     assert g.shape == (1, 3)
     assert np.abs(g).sum() > 0
+
+
+def test_monitor_collects_stats(caplog):
+    import logging
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+    mod = mx.mod.Module(out)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    Y = rng.randint(0, 4, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)
+
+    mon = mx.Monitor(interval=2, pattern=".*fc.*")
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.monitor"):
+        mod.fit(it, num_epoch=1, monitor=mon,
+                optimizer_params={"learning_rate": 0.1})
+    msgs = [r.message for r in caplog.records
+            if r.name == "mxnet_tpu.monitor"]
+    assert any("fc_weight" in m for m in msgs), msgs
+    assert any("fc_weight_grad" in m for m in msgs), msgs
+    # pattern filtering: nothing outside fc*
+    assert not any("softmax" in m for m in msgs)
+    # manual tic/toc returns triples
+    mon2 = mx.Monitor(interval=1)
+    mod.install_monitor(mon2)
+    mon2.tic()
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    stats = mon2.toc()
+    assert stats and all(len(t) == 3 for t in stats)
+
+
+def test_monitor_with_bucketing_module(caplog):
+    import logging
+
+    import mxnet_tpu as mx
+
+    sents = [[1, 2, 3, 1], [2, 3, 1, 2], [1, 2], [3, 1]] * 4
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[2, 4],
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=4, output_dim=4, name="emb")
+        pred = mx.sym.FullyConnected(
+            mx.sym.Reshape(emb, shape=(-1, 4)), num_hidden=4, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label, name="softmax"), \
+            ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=4)
+    mon = mx.Monitor(interval=1, pattern=".*pred.*")
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.monitor"):
+        mod.fit(it, num_epoch=1, monitor=mon,
+                eval_metric=mx.metric.Perplexity(ignore_label=None),
+                optimizer_params={"learning_rate": 0.1})
+    msgs = [r.message for r in caplog.records if r.name == "mxnet_tpu.monitor"]
+    assert any("pred_weight" in m for m in msgs), msgs
+    # idempotent install: one stat line per watched name per batch
+    names = [m.split()[-2] for m in msgs]
+    from collections import Counter
+
+    per_batch = Counter(m.split()[1] + ":" + m.split()[-2] for m in msgs)
+    assert max(per_batch.values()) <= 2  # at most once per bucket module
