@@ -46,6 +46,9 @@ from . import model
 from . import operator
 from . import rnn
 from . import monitor
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from .monitor import Monitor
 from . import profiler
 from . import runtime

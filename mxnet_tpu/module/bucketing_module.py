@@ -46,8 +46,10 @@ class BucketingModule(BaseModule):
                       fixed_param_names=self._fixed_param_names)
 
     def install_monitor(self, mon) -> None:
-        """Watch every bucket's executor (reference: BucketingModule
-        installs on all executor groups)."""
+        """Watch every bucket's executor, including ones created later
+        (reference: BucketingModule installs on all executor groups).
+        May be called before bind(): bind installs on the default bucket.
+        """
         self._monitor = mon
         for m in self._buckets.values():
             m.install_monitor(mon)
@@ -62,6 +64,8 @@ class BucketingModule(BaseModule):
         mod.bind(data_shapes, label_shapes, for_training=for_training,
                  grad_req=grad_req)
         self._buckets[self._default_bucket_key] = mod
+        if getattr(self, "_monitor", None) is not None:
+            mod.install_monitor(self._monitor)  # pre-bind install_monitor
         self._curr_module = mod
         self._curr_bucket_key = self._default_bucket_key
         self.binded = True

@@ -71,8 +71,12 @@ class Module(BaseModule):
                 req[name] = "null"
             else:
                 req[name] = grad_req
+        prev_exec = self._exec
         self._exec = self._symbol.simple_bind(ctx=self._context,
                                               grad_req=req, **shapes)
+        if getattr(self, "_monitor", None) is not None:
+            # force_rebind: keep the monitor on the LIVE executor
+            self._monitor.replace(prev_exec, self._exec)
         if shared_module is not None and shared_module._exec is not None:
             # share parameter arrays with another module (reference:
             # BucketingModule's shared executor groups): same NDArray objects
@@ -94,6 +98,7 @@ class Module(BaseModule):
         see mxnet_tpu/monitor.py docstring)."""
         if self._exec is None:
             raise MXNetError("bind() before install_monitor")
+        self._monitor = mon
         mon.install(self._exec)
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

@@ -45,6 +45,12 @@ class Monitor:
         if not any(e is exe for e in self.exes):
             self.exes.append(exe)
 
+    def replace(self, old_exe, new_exe) -> None:
+        """Swap a rebound module's executor (force_rebind) so stats never
+        come from the abandoned executor's frozen arrays."""
+        self.exes = [e for e in self.exes if e is not old_exe]
+        self.install(new_exe)
+
     # ------------------------------------------------------------------
     def tic(self) -> None:
         """Start collection for this batch when the interval hits."""
@@ -69,6 +75,13 @@ class Monitor:
         if not self.activated:
             return []
         for exe in self.exes:
+            # only executors that ran since the last toc (bucketing: the
+            # inactive buckets' outputs are stale and their shared params
+            # would be reported twice).  Executors outside a fit loop
+            # default to "ran" so manual tic/forward/toc works.
+            if not getattr(exe, "_monitor_ran", True):
+                continue
+            exe._monitor_ran = False
             for name, arr in getattr(exe, "arg_dict", {}).items():
                 self._collect(name, arr)
             for name, arr in (getattr(exe, "grad_dict", {}) or {}).items():

@@ -31,7 +31,11 @@ def _make_sym_stub(op):
         no_bias_default = bool(sig.parameters["no_bias"].default)
 
     def stub(*args, **kwargs):
-        name = kwargs.pop("name", None) or _auto_name(op.name)
+        from .. import name as _nm
+
+        explicit = kwargs.pop("name", None)
+        name = (explicit if explicit is not None
+                else _nm.current().get(None, _auto_name(op.name)))
         kwargs.pop("attr", None)
         sym_inputs = []
         # positional symbols fill required slots, then varargs
@@ -81,6 +85,13 @@ def _make_sym_stub(op):
             raise MXNetError(f"{op.name}: unknown attrs {sorted(bad)}")
         entries = [s._entries[0] for s in sym_inputs]
         node = _Node(op.name, name, kwargs, entries)
+        # AttrScope string attrs attach to op nodes too (introspection /
+        # serialization metadata; op semantics come from kwargs)
+        from .. import attribute as _attribute
+
+        scoped = _attribute.current().get(None)
+        if scoped:
+            node.vattrs = {"attr": scoped}
         n_out = static_num_outputs(op.name, kwargs)
         node.num_outputs = n_out
         return Symbol([(node, i) for i in range(n_out)])

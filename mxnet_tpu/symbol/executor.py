@@ -79,6 +79,8 @@ class Executor:
     def forward(self, is_train: bool = False, **kwargs):
         from ..ndarray import NDArray, array
 
+        self._monitor_ran = True  # mx.Monitor: this executor ran
+
         for name, val in kwargs.items():
             if name not in self.arg_dict:
                 raise MXNetError(f"unknown argument {name!r}")

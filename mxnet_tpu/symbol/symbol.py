@@ -630,6 +630,16 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs) -> Symbol:
     if not isinstance(name, str):
         raise MXNetError("Variable name must be a string")
+    from .. import attribute as _attribute
+
+    # AttrScope attrs apply to variables created in the scope (reference
+    # attribute.py); explicit attr wins.  __lr_mult__/__wd_mult__ scope
+    # attrs map onto the typed fields when not given explicitly.
+    attr = _attribute.current().get(attr)
+    if lr_mult is None and "__lr_mult__" in attr:
+        lr_mult = float(attr["__lr_mult__"])
+    if wd_mult is None and "__wd_mult__" in attr:
+        wd_mult = float(attr["__wd_mult__"])
     vattrs = {"shape": None if shape is None else tuple(shape),
               "dtype": dtype, "attr": dict(attr or {}), "init": init,
               "lr_mult": lr_mult, "wd_mult": wd_mult}

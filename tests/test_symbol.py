@@ -156,3 +156,47 @@ def test_grad_req_add():
     exe.forward(is_train=True, data=xv, w=wv)
     exe.backward()
     np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), 2 * xv)
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4)
+    assert s.list_outputs()[0].startswith("stage1_fullyconnected")
+    # explicit names are untouched
+    with mx.name.Prefix("p_"):
+        s2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                   name="fc9")
+    assert "fc9_output" in s2.list_outputs()[0]
+
+
+def test_attr_scope_on_variables():
+    with mx.AttrScope(__lr_mult__="0.1", group="encoder"):
+        v = mx.sym.Variable("w")
+        with mx.AttrScope(group="decoder"):  # inner wins
+            v2 = mx.sym.Variable("w2")
+    node = v._entries[0][0]
+    assert node.vattrs["lr_mult"] == 0.1
+    assert node.vattrs["attr"]["group"] == "encoder"
+    assert v2._entries[0][0].vattrs["attr"]["group"] == "decoder"
+    # explicit attr beats the scope
+    with mx.AttrScope(group="a"):
+        v3 = mx.sym.Variable("w3", attr={"group": "b"})
+    assert v3._entries[0][0].vattrs["attr"]["group"] == "b"
+    # values must be strings, reference convention
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        mx.AttrScope(x=1)
+
+
+def test_attr_scope_reuse_and_op_nodes():
+    scope = mx.AttrScope(group="g")
+    with scope:
+        with scope:
+            s = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+    # scope fully restored after nested reuse of ONE instance
+    v_after = mx.sym.Variable("w_after")
+    assert "group" not in v_after._entries[0][0].vattrs["attr"]
+    # op nodes carry the scope attrs for introspection
+    node = s._entries[0][0]
+    assert node.vattrs.get("attr", {}).get("group") == "g"
