@@ -1,45 +1,186 @@
 """Headline benchmark: ResNet-50 v1b ImageNet-shape training throughput
 (images/sec/chip), bf16, fused forward+backward+SGD step — BASELINE config 2.
+Set BENCH_MODEL=bert for the secondary metric (BERT-base MLM tokens/sec).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline: MXNet-CUDA ResNet-50 fp16 on V100 ~1450 img/s/GPU (BASELINE.md).
+
+Robustness contract (r3 verdict #1): the TPU relay can HANG (not just
+raise) during backend init or mid-compute, and has burned two rounds of
+driver benches.  This file is therefore an ORCHESTRATOR: it probes the
+TPU backend in a subprocess with a hard timeout, retries with backoff,
+runs the measurement itself in a subprocess with a hard timeout, and on
+any failure falls back to a CPU measurement — so it ALWAYS emits exactly
+one parseable JSON line on stdout and exits 0.
+
+Child modes (internal):
+    python bench.py --probe            # init axon backend, print device list
+    python bench.py --child PLATFORM   # run the measurement on cpu|tpu
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-
-def _setup_platform():
-    # prefer the real TPU when the axon relay is configured
-    if "JAX_PLATFORMS" not in os.environ and os.path.isdir("/root/.axon_site"):
-        os.environ["PYTHONPATH"] = "/root/.axon_site"
-        os.environ["JAX_PLATFORMS"] = "axon"
-        sys.path.insert(0, "/root/.axon_site")
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+PROBE_BACKOFFS = (5.0, 20.0, 45.0)  # sleep between probe attempts
+RUN_TIMEOUT_TPU = float(os.environ.get("BENCH_RUN_TIMEOUT", 1500))
+RUN_TIMEOUT_CPU = float(os.environ.get("BENCH_RUN_TIMEOUT_CPU", 900))
 
 
-def bench_bert():
-    """Secondary metric (BASELINE): BERT-base MLM pretrain tokens/sec/chip,
-    bf16 fused step.  Baseline: GluonNLP fp16 on V100 ~3000 tok/s/GPU."""
-    _setup_platform()
+def _axon_env():
+    env = dict(os.environ)
+    if os.path.isdir("/root/.axon_site"):
+        env["PYTHONPATH"] = "/root/.axon_site" + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "axon"
+    return env
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def probe_main():
+    """Child: initialise the axon TPU backend and report devices.  May hang
+    (the relay wedges) — the parent enforces the timeout."""
     import jax
-    import numpy as np
 
+    devs = jax.devices()
+    print(json.dumps({"n_devices": len(devs),
+                      "platforms": sorted({d.platform for d in devs})}))
+
+
+def _probe_tpu(history):
+    """Run the probe subprocess with retries.  Returns True if a non-cpu
+    backend answered within the timeout."""
+    for attempt in range(len(PROBE_BACKOFFS) + 1):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--probe"],
+                env=_axon_env(), capture_output=True, text=True,
+                timeout=PROBE_TIMEOUT)
+            dt = round(time.time() - t0, 1)
+            if out.returncode == 0:
+                try:
+                    info = json.loads(out.stdout.strip().splitlines()[-1])
+                except (ValueError, IndexError):
+                    info = {}
+                if info and "cpu" not in info.get("platforms", ["cpu"]):
+                    history.append({"attempt": attempt, "ok": True, "s": dt})
+                    return True
+                # a healthy cpu-only answer is a definitive "no TPU here",
+                # not a transient relay failure — don't burn the backoffs
+                history.append({"attempt": attempt, "ok": False, "s": dt,
+                                "why": f"cpu-only backend {info}"})
+                return False
+            else:
+                tail = (out.stderr or out.stdout or "").strip().splitlines()
+                history.append({"attempt": attempt, "ok": False, "s": dt,
+                                "why": " | ".join(tail[-2:])[:300]})
+        except subprocess.TimeoutExpired:
+            history.append({"attempt": attempt, "ok": False,
+                            "s": round(time.time() - t0, 1), "why": "hang"})
+        if attempt < len(PROBE_BACKOFFS):
+            time.sleep(PROBE_BACKOFFS[attempt])
+    return False
+
+
+def _run_child(platform, timeout, history):
+    """Run the measurement subprocess; return the parsed JSON dict or None."""
+    t0 = time.time()
+    env = _axon_env() if platform == "tpu" else _cpu_env()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        history.append({"run": platform, "ok": False,
+                        "s": round(time.time() - t0, 1), "why": "hang"})
+        return None
+    dt = round(time.time() - t0, 1)
+    for line in reversed((out.stdout or "").strip().splitlines()):
+        try:
+            res = json.loads(line)
+            if isinstance(res, dict) and "metric" in res:
+                history.append({"run": platform, "ok": True, "s": dt})
+                return res
+        except ValueError:
+            continue
+    tail = (out.stderr or out.stdout or "").strip().splitlines()
+    history.append({"run": platform, "ok": False, "s": dt,
+                    "why": " | ".join(tail[-2:])[:300]})
+    return None
+
+
+def main():
+    history = []
+    on_tpu = _probe_tpu(history)
+    result = None
+    if on_tpu:
+        result = _run_child("tpu", RUN_TIMEOUT_TPU, history)
+        if result is None:  # one retry — compile caches make it cheaper
+            result = _run_child("tpu", RUN_TIMEOUT_TPU, history)
+    if result is None:
+        result = _run_child("cpu", RUN_TIMEOUT_CPU, history)
+    if result is None:  # even CPU failed: still emit one parseable line
+        model = os.environ.get("BENCH_MODEL", "resnet")
+        result = {
+            "metric": ("bert_base_mlm_tokens_per_sec_per_chip"
+                       if model == "bert" else
+                       "resnet50_v1b_train_images_per_sec_per_chip"),
+            "value": 0.0,
+            "unit": "tokens/sec" if model == "bert" else "images/sec",
+            "vs_baseline": 0.0,
+            "error": "all bench subprocesses failed",
+            "probe_history": history,
+        }
+    else:
+        result["probe_history"] = history
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# measurement children
+
+
+def _common_setup(platform):
+    on_tpu = platform == "tpu"
+    if not on_tpu:
+        # JAX_PLATFORMS=cpu in the env is NOT enough: the axon shim
+        # intercepts backend lookup and can still hang on the relay.
+        # jax.config.update before first device touch reliably pins cpu.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import mxnet_tpu as mx
-    from mxnet_tpu import gluon, nd
-    from mxnet_tpu.models import bert_base
-    from mxnet_tpu.parallel import DataParallelStep, local_mesh
-
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    batch = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 2))
-    seqlen = int(os.environ.get("BENCH_SEQLEN", 512 if on_tpu else 64))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
 
     mx.random.seed(0)
     ctx = mx.tpu() if on_tpu else mx.cpu()
     mx.context.Context._default_ctx.value = ctx
+    return mx, ctx, on_tpu
+
+
+def bench_bert(platform):
+    """Secondary metric (BASELINE): BERT-base MLM pretrain tokens/sec/chip,
+    bf16 fused step.  Baseline: GluonNLP fp16 on V100 ~3000 tok/s/GPU."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.models import bert_base
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 2))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 512 if on_tpu else 64))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+
     net = bert_base()
     net.initialize(mx.init.Normal(0.02))
     if on_tpu:
@@ -58,6 +199,10 @@ def bench_bert():
     labels = tokens.astype(np.float32)
     tb = nd.array(tokens, ctx=ctx, dtype="int32")
     lb = nd.array(labels, ctx=ctx)
+    # warmup (compile).  NB: block_until_ready does not actually block
+    # through the axon relay — materialize the loss on the host to force
+    # the full step chain (each step's loss depends on the previous
+    # step's params, so this times every dispatched step).
     loss = step.step(tb, lb)
     float(np.asarray(loss))
     best_dt = float("inf")
@@ -74,32 +219,27 @@ def bench_bert():
         "value": round(tok_per_sec, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_sec / baseline, 4),
+        "platform": platform,
+        "batch": batch, "seqlen": seqlen,
     }))
 
 
-def main():
-    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
-        bench_bert()
-        return
-    _setup_platform()
-    import jax
+def bench_resnet(platform):
     import numpy as np
 
-    import mxnet_tpu as mx
+    mx, ctx, on_tpu = _common_setup(platform)
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
     from mxnet_tpu.parallel import DataParallelStep, local_mesh
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    # bs256 is the reference recipe (docs/faq/perf.md) and the r3-verdict
+    # lever #1; fits v5e HBM in bf16 with donation.
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 8))
     res = int(os.environ.get("BENCH_RES", 224 if on_tpu else 64))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
 
-    mx.random.seed(0)
-    ctx = mx.tpu() if on_tpu else mx.cpu()
-    mx.context.Context._default_ctx.value = ctx
-
-    net = resnet50_v1b()
+    net = resnet50_v1b(layout=layout)
     net.initialize(mx.init.Xavier())
     net.cast("bfloat16" if on_tpu else "float32")
 
@@ -109,8 +249,8 @@ def main():
         optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
 
-    x = np.random.rand(batch, 3, res, res).astype(
-        "float32")
+    shape = (batch, 3, res, res) if layout == "NCHW" else (batch, res, res, 3)
+    x = np.random.rand(*shape).astype("float32")
     y = np.random.randint(0, 1000, batch).astype("float32")
     if on_tpu:
         import ml_dtypes
@@ -118,10 +258,7 @@ def main():
         x = x.astype(ml_dtypes.bfloat16)
     xb, yb = nd.array(x, ctx=ctx, dtype=x.dtype), nd.array(y, ctx=ctx)
 
-    # warmup (compile).  NB: block_until_ready does not actually block
-    # through the axon relay — materialize the loss on the host to force
-    # the full step chain (each step's loss depends on the previous
-    # step's params, so this times every dispatched step).
+    # warmup (compile); host-materialized sync — see bench_bert note.
     loss = step.step(xb, yb)
     float(np.asarray(loss))
 
@@ -135,14 +272,27 @@ def main():
 
     img_per_sec = batch * steps / best_dt
     baseline = 1450.0  # MXNet-CUDA V100 fp16 (BASELINE.md)
-    result = {
+    print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / baseline, 4),
-    }
-    print(json.dumps(result))
+        "platform": platform,
+        "batch": batch, "layout": layout,
+    }))
+
+
+def child_main(platform):
+    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
+        bench_bert(platform)
+    else:
+        bench_resnet(platform)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        probe_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        main()

@@ -46,10 +46,17 @@ def track(nd) -> None:
 def wait_all() -> None:
     """Block until all outstanding device work is complete.
 
-    Reference: MXNDArrayWaitAll -> Engine::WaitForAll.
+    Reference: MXNDArrayWaitAll -> Engine::WaitForAll.  Failed async
+    computations surface HERE (it is the barrier users call to flush
+    errors): every live array is drained, then the first failure is
+    re-raised (r3 verdict: swallowing it dropped async errors silently).
     """
+    first_err = None
     for nd in list(_live):
         try:
             nd.wait_to_read()
-        except Exception:
-            pass
+        except Exception as e:  # drain the rest before raising
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err

@@ -2,7 +2,9 @@
 
 Reference parity: python/mxnet/gluon/nn/conv_layers.py (~L1-1200): Conv1D/2D/3D,
 Conv2DTranspose/Conv3DTranspose, Max/Avg pooling 1D/2D/3D, global pooling.
-NCHW-family layouts only (TPU/XLA handles layout internally).
+Supports NC[DHW] (MXNet default) and channel-last N[DHW]C layouts; on TPU
+channel-last is the MXU-native tiling (the reference's NHWC tensor-core
+analog, python/mxnet/gluon/nn/conv_layers.py layout= param).
 """
 from __future__ import annotations
 
@@ -11,6 +13,7 @@ from typing import Optional
 import numpy as np
 
 from ...base import MXNetError
+from ...ops.nn import _channels_last
 from ..block import HybridBlock
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
@@ -33,21 +36,27 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         ndim = len(kernel_size)
+        self._layout = layout
+        self._channel_axis = -1 if _channels_last(layout) else 1
         self._kwargs = {
             "kernel": kernel_size, "stride": strides, "dilate": dilation,
             "pad": padding, "num_filter": channels, "num_group": groups,
-            "no_bias": not use_bias}
+            "no_bias": not use_bias, "layout": layout}
         if adj is not None:
             self._kwargs["adj"] = adj
         self._op_name = op_name
         self._act_type = activation
         with self.name_scope():
-            if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + kernel_size
+            ig = in_channels // groups if in_channels else 0
+            og = channels // groups if channels else 0
+            if self._channel_axis == -1:  # weight layout follows data layout
+                wshape = ((channels,) + kernel_size + (ig,)
+                          if op_name == "Convolution"
+                          else (in_channels,) + kernel_size + (og,))
+            elif op_name == "Convolution":
+                wshape = (channels, ig) + kernel_size
             else:  # Deconvolution weight layout (in, out/group, *k)
-                wshape = (in_channels, channels // groups if channels else 0) \
-                    + kernel_size
+                wshape = (in_channels, og) + kernel_size
             self.weight = self.params.get(
                 "weight", shape=wshape, init=weight_initializer,
                 allow_deferred_init=True)
@@ -56,15 +65,18 @@ class _Conv(HybridBlock):
                 allow_deferred_init=True) if use_bias else None)
 
     def infer_shape(self, x, *args):
-        in_c = int(x.shape[1])
+        in_c = int(x.shape[self._channel_axis])
         groups = self._kwargs["num_group"]
-        k = self._kwargs["kernel"]
-        if self._op_name == "Convolution":
-            self.weight._set_shape_if_deferred(
-                (self._channels, in_c // groups) + tuple(k))
+        k = tuple(self._kwargs["kernel"])
+        if self._channel_axis == -1:
+            wshape = ((self._channels,) + k + (in_c // groups,)
+                      if self._op_name == "Convolution"
+                      else (in_c,) + k + (self._channels // groups,))
+        elif self._op_name == "Convolution":
+            wshape = (self._channels, in_c // groups) + k
         else:
-            self.weight._set_shape_if_deferred(
-                (in_c, self._channels // groups) + tuple(k))
+            wshape = (in_c, self._channels // groups) + k
+        self.weight._set_shape_if_deferred(wshape)
         if self.bias is not None:
             self.bias._set_shape_if_deferred((self._channels,))
 
@@ -172,7 +184,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 

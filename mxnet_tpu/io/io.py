@@ -276,13 +276,18 @@ class LibSVMIter(DataIter):
     """libsvm-format iterator yielding csr batches (reference:
     src/io/iter_libsvm.cc).  Rows are kept as (indices, values) pairs —
     only one batch is ever densified (batch_size x n_feat), so huge
-    feature spaces don't blow up host memory.  1-based index files
-    (liblinear/svmlight convention) are detected when the max index
-    equals n_feat (it would be out of range 0-based) and shifted.
+    feature spaces don't blow up host memory.
+
+    Indexing: pass one_based=True for 1-based files (liblinear/svmlight
+    convention) or one_based=False for 0-based.  The default (None) keeps
+    the legacy heuristic — shift when the max index equals n_feat (it would
+    be out of range 0-based) — but warns when it triggers, because a
+    1-based file that never uses the last feature id is indistinguishable
+    from a 0-based one (r3 advisor finding).
     """
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, one_based=None, **kwargs):
         super().__init__(batch_size)
         self._n_feat = int(np.prod(data_shape))
         rows, labels = [], []
@@ -304,13 +309,26 @@ class LibSVMIter(DataIter):
                         labels.append(float(line.split()[0]))
         max_idx = max((int(i.max()) for i, _ in rows if i.size), default=0)
         min_idx = min((int(i.min()) for i, _ in rows if i.size), default=0)
-        if max_idx >= self._n_feat:
-            if min_idx >= 1 and max_idx == self._n_feat:
-                rows = [(i - 1, v) for i, v in rows]  # 1-based file
-            else:
+        has_feats = any(i.size for i, _ in rows)
+        if one_based is True:
+            if has_feats and min_idx < 1:
                 raise MXNetError(
-                    f"libsvm feature index {max_idx} out of range for "
-                    f"data_shape {data_shape}")
+                    f"one_based=True but found feature index {min_idx}")
+            rows = [(i - 1, v) for i, v in rows]
+        elif one_based is None and max_idx >= self._n_feat \
+                and min_idx >= 1 and max_idx == self._n_feat:
+            import warnings
+
+            warnings.warn(
+                "LibSVMIter: max feature index equals n_feat; assuming a "
+                "1-based file and shifting indices.  Pass one_based=True/"
+                "False to silence this heuristic.", stacklevel=2)
+            rows = [(i - 1, v) for i, v in rows]
+        max_idx = max((int(i.max()) for i, _ in rows if i.size), default=0)
+        if max_idx >= self._n_feat:
+            raise MXNetError(
+                f"libsvm feature index {max_idx} out of range for "
+                f"data_shape {data_shape}")
         self._rows = rows
         self._labels = np.asarray(labels, np.float32)
         self._round = round_batch

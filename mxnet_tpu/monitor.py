@@ -28,8 +28,10 @@ class Monitor:
             def stat_func(arr):
                 import numpy as np
 
-                a = np.abs(arr)
-                return float(a.mean())  # reference default: mean |x|
+                # reference default: norm(x)/sqrt(x.size) i.e. RMS
+                # (python/mxnet/monitor.py asum_stat)
+                a = np.asarray(arr, dtype=np.float64)
+                return float(np.sqrt(np.mean(np.square(a))))
         self.stat_func = stat_func
         self.re_pattern = re.compile(pattern)
         self.sort = sort

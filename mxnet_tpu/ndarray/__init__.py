@@ -119,6 +119,14 @@ def shuffle(data, out=None):
     return _reg.invoke_by_name("_shuffle", [key, data], out=out)
 
 
+def onehot_encode(indices, out):
+    """Legacy one-hot (reference src/ndarray/ndarray_function.cc
+    OnehotEncode): writes the encoding INTO the second argument in place
+    and returns it — legacy callers read `out` after a positional call
+    (r3 advisor finding), so out= is mandatory here."""
+    return _reg.invoke_by_name("onehot_encode", [indices, out], out=out)
+
+
 def cast_storage(data, stype="default", out=None):
     """Convert between dense and sparse storage (reference:
     src/operator/tensor/cast_storage.cc).  Thin op-name facade over
@@ -139,7 +147,8 @@ def cast_storage(data, stype="default", out=None):
     return res
 
 
-_SPECIAL = {"Dropout": Dropout, "BatchNorm": BatchNorm, "_shuffle": shuffle}
+_SPECIAL = {"Dropout": Dropout, "BatchNorm": BatchNorm, "_shuffle": shuffle,
+            "onehot_encode": onehot_encode}
 _SKIP_PREFIXES = ("_random_", "_sample_", "sample_")
 
 

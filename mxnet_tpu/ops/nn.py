@@ -5,7 +5,9 @@ pooling, activation, softmax, dropout, layer_norm, lrn, upsampling ...) and
 the cuDNN/MIOpen wrapper family.  On TPU the vendor-library role is played by
 XLA itself: conv/matmul lower onto the MXU (lax.conv_general_dilated /
 dot_general), normalizations and activations fuse into neighbouring HLO.
-All spatial ops use MXNet's native NC[DHW] layouts.
+Spatial ops default to MXNet's native NC[DHW] layouts; conv/pool also accept
+the channel-last layouts (NWC/NHWC/NDHWC) the reference reserves for its
+tensor-core paths — on TPU channel-last is the MXU-friendly tiling.
 """
 from __future__ import annotations
 
@@ -67,12 +69,25 @@ def _conv_dims(kernel):
     return len(kernel)
 
 
+def _channels_last(layout):
+    """True for MXNet channel-last layouts (NWC/NHWC/NDHWC).
+
+    The reference supports these for cuDNN tensor-core paths
+    (src/operator/nn/convolution.cu layout-specialized kernels); on TPU the
+    channel-last path is the MXU-friendly tiling — XLA avoids the implicit
+    layout conversions it inserts around NCHW convs.
+    """
+    return layout is not None and layout.endswith("C") and layout != "NC"
+
+
 @register("Convolution")
 def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
                 num_filter=1, num_group=1, no_bias=False, workspace=1024,
                 cudnn_tune=None, cudnn_off=False, layout=None):
-    """N-d convolution, OIHW weights (reference src/operator/nn/convolution-inl.h).
+    """N-d convolution (reference src/operator/nn/convolution-inl.h).
 
+    Weight layout follows the data layout as in MXNet: OI<spatial> for
+    NC-first (default), O<spatial>I for channel-last (NHWC family).
     cudnn_* attrs are accepted and ignored: algorithm selection is XLA's job.
     """
     n = _conv_dims(kernel)
@@ -80,10 +95,11 @@ def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     dilate = _pair(dilate or 1, n)
     pad = _pair(pad, n)
     spatial = "DHW"[-n:]
-    dn = jax.lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        ("NC" + spatial, "OI" + spatial, "NC" + spatial),
-    )
+    if _channels_last(layout):
+        specs = ("N" + spatial + "C", "O" + spatial + "I", "N" + spatial + "C")
+    else:
+        specs = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, specs)
     lhs, rhs, downcast = _safe_acc(data, weight)
     out = jax.lax.conv_general_dilated(
         lhs, rhs,
@@ -96,7 +112,10 @@ def convolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     if downcast:
         out = out.astype(data.dtype)
     if not no_bias and bias:
-        b = bias[0].reshape((1, -1) + (1,) * n)
+        if _channels_last(layout):
+            b = bias[0].reshape((1,) * (n + 1) + (-1,))
+        else:
+            b = bias[0].reshape((1, -1) + (1,) * n)
         out = out + b
     return out
 
@@ -114,10 +133,11 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     pad = _pair(pad, n)
     adj = _pair(adj, n) if adj else (0,) * n
     spatial = "DHW"[-n:]
-    dn = jax.lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        ("NC" + spatial, "IO" + spatial, "NC" + spatial),
-    )
+    if _channels_last(layout):
+        specs = ("N" + spatial + "C", "I" + spatial + "O", "N" + spatial + "C")
+    else:
+        specs = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, specs)
     # lhs_dilation implements the fractional stride; padding chosen so that
     # out = (in-1)*s - 2p + dilate*(k-1) + 1 + adj  (MXNet's formula)
     pads = []
@@ -139,7 +159,10 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     if downcast:
         out = out.astype(data.dtype)
     if not no_bias and bias:
-        out = out + bias[0].reshape((1, -1) + (1,) * n)
+        if _channels_last(layout):
+            out = out + bias[0].reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias[0].reshape((1, -1) + (1,) * n)
     return out
 
 
@@ -150,29 +173,41 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
 def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
             pad=(), pooling_convention="valid", count_include_pad=True,
             cudnn_off=False, p_value=2, layout=None):
-    """Spatial pooling (reference src/operator/nn/pooling-inl.h)."""
+    """Spatial pooling (reference src/operator/nn/pooling-inl.h).
+
+    Channel-last layouts (NWC/NHWC/NDHWC) pool over the middle dims."""
     n = data.ndim - 2
+    last = _channels_last(layout)
+    sp0 = 1 if last else 2  # first spatial dim index
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + n]
         stride = (1,) * n
         pad = (0,) * n
     kernel = _pair(kernel, n)
     stride = _pair(stride or 1, n)
     pad = _pair(pad, n)
 
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil-mode: pad on the high side enough to cover the last window
         extra = []
         for i in range(n):
-            in_i = data.shape[2 + i]
+            in_i = data.shape[sp0 + i]
             out_i = int(np.ceil((in_i + 2 * pad[i] - kernel[i]) / stride[i])) + 1
             need = (out_i - 1) * stride[i] + kernel[i] - in_i - pad[i]
             extra.append(max(need, pad[i]))
-        pads = ((0, 0), (0, 0)) + tuple((pad[i], extra[i]) for i in range(n))
+        sp_pads = tuple((pad[i], extra[i]) for i in range(n))
     else:
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        sp_pads = tuple((p, p) for p in pad)
+    if last:
+        pads = ((0, 0),) + sp_pads + ((0, 0),)
+    else:
+        pads = ((0, 0), (0, 0)) + sp_pads
 
     # dtype-safe identities: bfloat16 (ml_dtypes) reports numpy kind 'V',
     # so go through jnp.issubdtype rather than dtype.kind (the BENCH_r02
@@ -362,6 +397,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     `training` comes from autograd train-mode, threaded by the caller.
     """
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    axis = axis % data.ndim  # normalize negatives (axis=-1 for NHWC nets)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     red = tuple(i for i in range(data.ndim) if i != axis)

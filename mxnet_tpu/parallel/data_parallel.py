@@ -113,7 +113,14 @@ class DataParallelStep:
                  optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
                  rules: Optional[ShardingRules] = None,
                  batch_axes: Sequence[str] = ("dp", "sp"),
+                 seq_axis: Optional[int] = None,
                  donate: bool = True):
+        """seq_axis: which input dim is the sequence dim for sequence
+        parallelism over an 'sp' mesh axis.  None (default) auto-detects:
+        dim 1 is treated as the sequence dim only when it is divisible by
+        the sp axis size; otherwise (e.g. NCHW/NHWC image batches) the
+        batch dim is sharded over dp*sp as plain data parallelism.  Pass
+        seq_axis=1 to force SP, seq_axis=-1 to disable it."""
         import jax
 
         from ..context import current_context
@@ -127,6 +134,10 @@ class DataParallelStep:
         self.loss_fn = loss_fn
         self.rules = rules or ShardingRules()
         self._batch_axes = tuple(batch_axes)
+        if seq_axis not in (None, 1, -1):
+            raise MXNetError("seq_axis must be None (auto), 1 (force SP on "
+                             "dim 1) or -1 (disable SP)")
+        self._seq_axis = seq_axis
         opt_params = dict(optimizer_params or {})
         self._lr = opt_params.get("learning_rate", 0.01)
         self._momentum = opt_params.get("momentum", 0.9)
@@ -260,13 +271,21 @@ class DataParallelStep:
         label_arr = label._data if isinstance(label, NDArray) else label
         # with an active 'sp' axis, shard the sequence dim (1) over it:
         # true sequence parallelism — GSPMD emits the cross-device
-        # collectives for attention over the sharded T axis
+        # collectives for attention over the sharded T axis.
+        # Gated (r3 advisor): only when the caller opted in via seq_axis=1,
+        # or in auto mode when dim 1 is actually divisible by the sp size —
+        # image batches (NCHW: dim 1 = 3 channels) fall back to plain
+        # dp*sp batch sharding, which is what r2 did for any rank.
         sp_active = (
             "sp" in self.mesh.axis_names
             and self.mesh.shape["sp"] > 1
             and "sp" in self._batch_axes
+            and self._seq_axis != -1
+            and np.ndim(data_arr) >= 2
         )
-        if sp_active and np.ndim(data_arr) >= 2:
+        if sp_active and self._seq_axis is None:
+            sp_active = np.shape(data_arr)[1] % self.mesh.shape["sp"] == 0
+        if sp_active:
             from .sharding import shard_batch_seq
 
             dsh = shard_batch_seq(self.mesh, np.ndim(data_arr))

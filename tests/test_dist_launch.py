@@ -35,6 +35,21 @@ def test_dist_sync_training_two_workers():
     assert res.stdout.count("dist train OK") == 2, res.stdout
 
 
+def test_dist_sync_kvstore_three_workers():
+    """n=3 exercises non-power-of-two reduction and rank indexing that n=2
+    cannot (reference CI: tools/launch.py -n 3 -s 3 --launcher local
+    tests/nightly/dist_sync_kvstore.py)."""
+    res = _launch(3, "tests/dist/dist_sync_kvstore_worker.py", timeout=300)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("dist_sync kvstore OK") == 3, res.stdout
+
+
+def test_dist_sync_training_three_workers():
+    res = _launch(3, "tests/dist/dist_train_worker.py", timeout=300)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("dist train OK") == 3, res.stdout
+
+
 def test_launch_detects_nonrank0_crash(tmp_path):
     """A crash in ANY rank must terminate the job promptly — rank 0 may be
     blocked in a collective waiting for the dead peer."""

@@ -1,0 +1,158 @@
+"""Channel-last (NHWC-family) layout support.
+
+Reference parity: MXNet's layout= parameter on Convolution/Pooling and the
+gluon conv/pool layers (python/mxnet/gluon/nn/conv_layers.py), used by the
+reference for cuDNN tensor-core paths (src/operator/nn/convolution.cu).
+On TPU, NHWC is the MXU-native tiling and the bench's training layout, so
+NHWC-vs-NCHW parity is load-bearing for the headline number.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _to_nhwc(x):
+    return np.transpose(x, (0, 2, 3, 1))
+
+
+@pytest.mark.smoke
+def test_conv2d_nhwc_matches_nchw():
+    x = np.random.rand(2, 5, 8, 8).astype(np.float32)
+    c1 = nn.Conv2D(7, 3, strides=2, padding=1, in_channels=5)
+    c1.initialize(mx.init.Xavier())
+    out1 = c1(nd.array(x)).asnumpy()
+
+    c2 = nn.Conv2D(7, 3, strides=2, padding=1, in_channels=5, layout="NHWC")
+    c2.initialize(mx.init.Xavier())
+    # OIHW -> OHWI
+    c2.weight.set_data(nd.array(c1.weight.data().asnumpy().transpose(0, 2, 3, 1)))
+    c2.bias.set_data(c1.bias.data())
+    out2 = c2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(_to_nhwc(out1), out2, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_nhwc_grouped():
+    x = np.random.rand(2, 6, 8, 8).astype(np.float32)
+    c1 = nn.Conv2D(8, 3, padding=1, groups=2, in_channels=6, use_bias=False)
+    c1.initialize(mx.init.Xavier())
+    out1 = c1(nd.array(x)).asnumpy()
+    c2 = nn.Conv2D(8, 3, padding=1, groups=2, in_channels=6, use_bias=False,
+                   layout="NHWC")
+    c2.initialize(mx.init.Xavier())
+    c2.weight.set_data(nd.array(c1.weight.data().asnumpy().transpose(0, 2, 3, 1)))
+    out2 = c2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(_to_nhwc(out1), out2, rtol=1e-5, atol=1e-5)
+
+
+def test_conv1d_nwc():
+    x = np.random.rand(2, 4, 9).astype(np.float32)
+    c1 = nn.Conv1D(5, 3, padding=1, in_channels=4)
+    c1.initialize(mx.init.Xavier())
+    out1 = c1(nd.array(x)).asnumpy()
+    c2 = nn.Conv1D(5, 3, padding=1, in_channels=4, layout="NWC")
+    c2.initialize(mx.init.Xavier())
+    c2.weight.set_data(nd.array(c1.weight.data().asnumpy().transpose(0, 2, 1)))
+    c2.bias.set_data(c1.bias.data())
+    out2 = c2(nd.array(x.transpose(0, 2, 1))).asnumpy()
+    np.testing.assert_allclose(out1, out2.transpose(0, 2, 1), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_pooling_nhwc():
+    x = np.random.rand(2, 3, 9, 9).astype(np.float32)
+    for mk_nchw, mk_nhwc in [
+        (nn.MaxPool2D(3, 2, 1), nn.MaxPool2D(3, 2, 1, layout="NHWC")),
+        (nn.AvgPool2D(2, 2, ceil_mode=True),
+         nn.AvgPool2D(2, 2, ceil_mode=True, layout="NHWC")),
+        (nn.GlobalAvgPool2D(), nn.GlobalAvgPool2D(layout="NHWC")),
+    ]:
+        out1 = mk_nchw(nd.array(x)).asnumpy()
+        out2 = mk_nhwc(nd.array(_to_nhwc(x))).asnumpy()
+        np.testing.assert_allclose(_to_nhwc(out1), out2, rtol=1e-6, atol=1e-6)
+
+
+def test_batchnorm_last_axis_train_and_eval():
+    x = np.random.rand(4, 6, 5, 3).astype(np.float32)  # NHWC, C=3
+    bn1 = nn.BatchNorm(axis=1)
+    bn2 = nn.BatchNorm(axis=-1)
+    bn1.initialize()
+    bn2.initialize()
+    xt = np.transpose(x, (0, 3, 1, 2))
+    with autograd.record():
+        o1 = bn1(nd.array(xt))
+    with autograd.record():
+        o2 = bn2(nd.array(x))
+    np.testing.assert_allclose(o1.asnumpy(), np.transpose(o2.asnumpy(), (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+    # moving stats must match (train-mode reduction over N,H,W only)
+    np.testing.assert_allclose(
+        bn1.running_mean.data().asnumpy(), bn2.running_mean.data().asnumpy(),
+        rtol=1e-5, atol=1e-6)
+    o1e = bn1(nd.array(xt)).asnumpy()
+    o2e = bn2(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(o1e, np.transpose(o2e, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.smoke
+def test_resnet18_nhwc_forward_parity():
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    mx.random.seed(0)
+    net1 = resnet18_v1()
+    net1.initialize(mx.init.Xavier())
+    x = np.random.rand(2, 3, 32, 32).astype(np.float32)
+    out1 = net1(nd.array(x)).asnumpy()
+
+    net2 = resnet18_v1(layout="NHWC")
+    net2.initialize(mx.init.Xavier())
+    net2(nd.array(_to_nhwc(x)))  # resolve deferred shapes
+    p1, p2 = net1.collect_params(), net2.collect_params()
+    k1s, k2s = sorted(p1.keys()), sorted(p2.keys())
+    for k1, k2 in zip(k1s, k2s):
+        a = p1[k1].data().asnumpy()
+        if a.ndim == 4:
+            a = a.transpose(0, 2, 3, 1)
+        p2[k2].set_data(nd.array(a))
+    out2 = net2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=2e-4)
+
+
+def test_resnet_nhwc_train_step():
+    """Hybridized fused train step in NHWC (the bench path) runs and learns."""
+    import jax
+
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    mx.random.seed(0)
+    net = resnet18_v1(layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = DataParallelStep(
+        net, loss_fn, mesh=local_mesh(devices=jax.devices("cpu")[:1]),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.05})
+    x = nd.array(np.random.rand(4, 24, 24, 3).astype(np.float32))
+    y = nd.array(np.random.randint(0, 10, 4).astype(np.float32))
+    losses = [float(np.asarray(step.step(x, y))) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_deconv_nhwc_matches_nchw():
+    x = np.random.rand(2, 4, 6, 6).astype(np.float32)
+    d1 = nn.Conv2DTranspose(5, 3, strides=2, padding=1, output_padding=1,
+                            in_channels=4)
+    d1.initialize(mx.init.Xavier())
+    out1 = d1(nd.array(x)).asnumpy()
+    d2 = nn.Conv2DTranspose(5, 3, strides=2, padding=1, output_padding=1,
+                            in_channels=4, layout="NHWC")
+    d2.initialize(mx.init.Xavier())
+    # IOHW -> IHWO
+    d2.weight.set_data(nd.array(d1.weight.data().asnumpy().transpose(0, 2, 3, 1)))
+    d2.bias.set_data(d1.bias.data())
+    out2 = d2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(_to_nhwc(out1), out2, rtol=1e-5, atol=1e-5)

@@ -176,9 +176,13 @@ def test_legacy_index_ops():
     mhs = nd.array(np.array([-1, -2, -3, -4], np.float32))
     filled = nd.fill_element_0index(lhs, mhs, rhs).asnumpy()
     assert filled[0, 0] == -1 and filled[1, 2] == -2
-    oh = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)),
-                          nd.zeros((2, 3))).asnumpy()
-    np.testing.assert_array_equal(oh, [[0, 1, 0], [1, 0, 0]])
+    tgt = nd.zeros((2, 3))
+    ret = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)), tgt)
+    np.testing.assert_array_equal(ret.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+    # legacy in-place semantics: the second positional arg IS the output
+    # (reference ndarray_function.cc OnehotEncode; r3 advisor finding)
+    assert ret is tgt
+    np.testing.assert_array_equal(tgt.asnumpy(), [[0, 1, 0], [1, 0, 0]])
 
 
 def test_linalg_gemm_trmm_potri():
