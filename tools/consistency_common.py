@@ -1,0 +1,79 @@
+"""Shared case evaluation for the cpu-vs-tpu consistency oracle.
+
+Replays tests/test_op_sweep.py's registry-wide cases on a given context;
+both halves of tools/check_consistency.py (the CPU parent and the TPU
+subprocess) import this so the evaluation is bit-identical code.
+
+Reference: tests/python/gpu/test_operator_gpu.py check_consistency ~L1300 —
+the framework's main correctness oracle for a new backend (SURVEY §4.4).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_cases():
+    """Import the sweep cases without pytest collecting anything."""
+    for p in (os.path.join(_REPO, "tests"), _REPO):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import test_op_sweep as sweep
+
+    return sweep
+
+
+def eval_case(case, ctx, with_grad=True):
+    """Deterministic forward (+ analytic gradient) of one sweep case on ctx.
+
+    Returns (list_of_forward_arrays, list_of_grad_arrays_or_None).
+    Inputs are seeded identically on every platform; gradients go through
+    the autograd tape (jax.vjp), i.e. the exact path training uses.
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    sweep = load_cases()
+    mx.random.seed(0)
+    rng = np.random.RandomState(11)
+    arrs = sweep._inputs_np(case, rng)
+    inputs = [nd.array(a, ctx=ctx) for a in arrs]
+
+    out = case.fn(*inputs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fwd = [np.asarray(o.asnumpy(), dtype=np.float64) for o in outs]
+
+    grads = None
+    if with_grad and case.grad:
+        inputs = [nd.array(a, ctx=ctx) for a in arrs]
+        for i, x in enumerate(inputs):
+            if i not in case.int_inputs:
+                x.attach_grad()
+        with autograd.record():
+            loss = sweep._sum_all(case.fn(*inputs))
+        loss.backward()
+        grads = [
+            (None if i in case.int_inputs or inputs[i].grad is None
+             else np.asarray(inputs[i].grad.asnumpy(), dtype=np.float64))
+            for i in range(len(inputs))
+        ]
+    return fwd, grads
+
+
+def compare(case, got, want, rtol, atol, kind):
+    """Compare one case's arrays; returns None on match, message on drift."""
+    for k, (a, b) in enumerate(zip(got, want)):
+        if a is None or b is None:
+            continue
+        scale = max(1.0, float(np.abs(np.asarray(b)).max()))
+        try:
+            np.testing.assert_allclose(a, b, rtol=rtol, atol=atol * scale)
+        except AssertionError as e:
+            return (f"{case.id} {kind}[{k}]: "
+                    + str(e).strip().splitlines()[0]
+                    + f" (max|Δ|={float(np.abs(np.asarray(a) - np.asarray(b)).max()):.3g})")
+    return None
