@@ -27,8 +27,12 @@ import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+# probe/env handling is bench.py's (retry-with-backoff, PYTHONPATH
+# preserved) — one implementation, not a drifting copy
+import bench as _bench
+
 # forward+grad per case is tiny; the budget is relay round-trips + compiles
 CHILD_TIMEOUT = float(os.environ.get("CONSISTENCY_TIMEOUT", 2400))
 
@@ -39,22 +43,13 @@ RTOL, ATOL = 2e-3, 2e-4
 
 
 def _axon_env():
-    env = dict(os.environ)
-    if os.path.isdir("/root/.axon_site"):
-        env["PYTHONPATH"] = "/root/.axon_site:" + _REPO
-        env["JAX_PLATFORMS"] = "axon"
+    env = _bench._axon_env()
+    env["PYTHONPATH"] = _REPO + ":" + env.get("PYTHONPATH", "")
     return env
 
 
 def _probe():
-    code = "import jax; d=jax.devices(); print(all(x.platform=='cpu' for x in d))"
-    try:
-        out = subprocess.run([sys.executable, "-c", code], env=_axon_env(),
-                             capture_output=True, text=True,
-                             timeout=PROBE_TIMEOUT)
-        return out.returncode == 0 and out.stdout.strip().endswith("False")
-    except subprocess.TimeoutExpired:
-        return False
+    return _bench._probe_tpu([])
 
 
 def tpu_child(case_ids, result_path):
@@ -175,11 +170,17 @@ def main():
 
     ctx = mx.cpu()
     mismatches, tpu_errors, compared = [], tpu["errors"], 0
+    cpu_errors = {}
     for case in cases:
         rec = tpu["results"].get(case.id)
         if rec is None:
             continue
-        fwd_cpu, grads_cpu = eval_case(case, ctx)
+        try:  # per-case guard, like the TPU child — one failure must not
+            # abort the run after the chip already spent its budget
+            fwd_cpu, grads_cpu = eval_case(case, ctx)
+        except Exception as e:
+            cpu_errors[case.id] = f"{type(e).__name__}: {e}"
+            continue
         fwd_tpu = [np.asarray(a) for a in rec["fwd"]]
         msg = compare(case, fwd_tpu, fwd_cpu, RTOL, ATOL, "fwd")
         if msg is None and grads_cpu is not None and rec["grads"] is not None:
@@ -198,6 +199,7 @@ def main():
         "cases_compared": compared,
         "mismatches": mismatches,
         "tpu_errors": tpu_errors,
+        "cpu_errors": cpu_errors,
         "rtol": RTOL, "atol": ATOL,
         "elapsed_s": round(time.time() - t0, 1),
     }
@@ -207,7 +209,8 @@ def main():
                       for k, v in report.items()}))
     # a sweep where nothing compared (or any case crashed on-chip) is NOT
     # a pass — the exit code is the CI contract
-    ok = compared > 0 and not mismatches and not tpu_errors
+    ok = (compared > 0 and not mismatches and not tpu_errors
+          and not cpu_errors)
     return 0 if ok else 1
 
 

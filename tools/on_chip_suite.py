@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""One-shot on-chip measurement suite (r4).
+
+Runs every TPU-dependent measurement the r3 verdict asked for, the moment
+the relay answers, each step in a subprocess with a hard timeout so one
+hang cannot kill the batch.  Artifacts land in docs/artifacts/ and a
+combined log in docs/artifacts/on_chip_suite.log.
+
+    python tools/on_chip_suite.py [--quick]
+
+Steps:
+  1. bench.py                       ResNet-50 bs256 NHWC (headline)
+  2. bench.py BENCH_LAYOUT=NCHW     layout ablation
+  3. bench.py BENCH_BATCH=128       batch ablation (r3 measured bs128)
+  4. bench.py BENCH_MODEL=bert      BERT-base tokens/sec (BASELINE #2)
+  5. tools/bench_step.py --device tpu   eager Trainer vs fused ratio
+  6. tools/check_consistency.py     434-case cpu-vs-tpu oracle
+  7. tools/dump_hlo.py --platform tpu --profile-steps 5   HLO + profile
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(_REPO, "docs", "artifacts")
+
+
+def run(name, cmd, env_extra=None, timeout=1800, log=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    print(f"=== {name}: {' '.join(cmd)} {env_extra or ''}", flush=True)
+    try:
+        p = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                           text=True, timeout=timeout)
+        out, rc = (p.stdout or ""), p.returncode
+        err = (p.stderr or "")[-2000:]
+    except subprocess.TimeoutExpired:
+        out, rc, err = "", -1, f"TIMEOUT after {timeout}s"
+    dt = round(time.time() - t0, 1)
+    rec = {"step": name, "rc": rc, "s": dt,
+           "stdout_tail": out.strip().splitlines()[-3:] if out else [],
+           "stderr_tail": err.strip().splitlines()[-3:] if err else []}
+    print(json.dumps(rec), flush=True)
+    if log is not None:
+        log.append(rec)
+    # persist any bench JSON line as its own artifact
+    for line in reversed(out.strip().splitlines()):
+        try:
+            j = json.loads(line)
+            if isinstance(j, dict) and "metric" in j:
+                path = os.path.join(ART, f"{name}.json")
+                with open(path, "w") as f:
+                    json.dump(j, f, indent=1)
+                break
+        except ValueError:
+            continue
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="shorter timeouts, skip the full consistency sweep")
+    args = ap.parse_args()
+    os.makedirs(ART, exist_ok=True)
+    py = sys.executable
+    log = []
+    t = 600 if args.quick else 1800
+
+    run("bench_resnet_bs256_nhwc", [py, "bench.py"], timeout=t, log=log)
+    run("bench_resnet_bs256_nchw", [py, "bench.py"],
+        {"BENCH_LAYOUT": "NCHW"}, timeout=t, log=log)
+    run("bench_resnet_bs128_nhwc", [py, "bench.py"],
+        {"BENCH_BATCH": "128"}, timeout=t, log=log)
+    run("bench_bert", [py, "bench.py"], {"BENCH_MODEL": "bert"},
+        timeout=t, log=log)
+    run("bench_step_eager_vs_fused",
+        [py, "tools/bench_step.py", "--device", "tpu", "--batch", "64",
+         "--res", "64", "--steps", "5"], timeout=t, log=log)
+    if not args.quick:
+        run("check_consistency", [py, "tools/check_consistency.py"],
+            timeout=3000, log=log)
+    run("dump_hlo_tpu",
+        [py, "tools/dump_hlo.py", "--platform", "tpu", "--batch", "256",
+         "--profile-steps", "5"], timeout=t, log=log)
+
+    with open(os.path.join(ART, "on_chip_suite.log"), "w") as f:
+        json.dump(log, f, indent=1)
+    print("suite complete:",
+          sum(1 for r in log if r["rc"] == 0), "/", len(log), "steps ok")
+
+
+if __name__ == "__main__":
+    main()
