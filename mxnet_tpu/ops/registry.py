@@ -40,6 +40,7 @@ class Operator:
         self.differentiable = differentiable
         self.__doc__ = doc or fn.__doc__
         self._jit_cache: Dict[Any, Callable] = {}
+        self._bwd_cache: Dict[Any, Callable] = {}
 
     def jitted(self, attrs: dict) -> Callable:
         key = canonical_kwargs(attrs)
@@ -59,8 +60,35 @@ class Operator:
             import jax
 
             jfn = jax.jit(call)
+            jfn._canonical_key = key
             self._jit_cache[key] = jfn
         return jfn
+
+    def bwd_jitted(self, jfn: Callable, mask: tuple) -> Callable:
+        """Compiled backward for this (attrs, detach-mask) signature.
+
+        The eager tape defers vjp construction to backward time (recording
+        an op costs one cached-jit forward, ~15µs, instead of a ~650µs
+        jax.vjp re-trace per call); the vjp itself runs through this cached
+        jit — forward is recomputed inside it (remat-style), which XLA
+        dead-code-eliminates down to the residuals the backward needs.
+
+        `jfn` must come from self.jitted() (its canonical key is reused so
+        the hot path canonicalizes attrs exactly once).
+        """
+        key = (jfn._canonical_key, mask)
+        bwd = self._bwd_cache.get(key)
+        if bwd is None:
+            import jax
+
+            fwd = _wrap_masked(jfn, mask)
+
+            def bwd_fn(xs, ct):
+                return jax.vjp(fwd, *xs)[1](ct)
+
+            bwd = jax.jit(bwd_fn)
+            self._bwd_cache[key] = bwd
+        return bwd
 
     def __repr__(self):
         return f"<Operator {self.name}>"
@@ -178,8 +206,19 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
             and arrays
             and any(_is_float(a) for a in arrays)
         ):
-            wrapped = _wrap_detached(jfn, inputs)
-            outs, vjp_fn = _vjp(wrapped, arrays)
+            # fast recording: forward through the cached jit (same cost as
+            # un-recorded eager); the vjp is DEFERRED to backward time and
+            # runs through a per-(op, attrs, mask) compiled backward —
+            # recording no longer pays a jax.vjp re-trace per call
+            mask = _detach_mask(inputs)
+            wrapped = _wrap_masked(jfn, mask)
+            outs = wrapped(*arrays)
+            bwd = op.bwd_jitted(jfn, mask)
+            in_arrays = tuple(arrays)
+
+            def vjp_fn(ct, _bwd=bwd, _xs=in_arrays):
+                return _bwd(_xs, ct)
+
             seq = isinstance(outs, (tuple, list))
             out_list = list(outs) if seq else [outs]
             # identity-like ops (e.g. SVMOutput's forward) can return an
@@ -228,10 +267,14 @@ def _stop_detached(arrays, inputs):
     ]
 
 
-def _wrap_detached(fn, inputs):
-    """Stop gradient flow through inputs marked detach()ed, without copying
-    their buffers or changing their tape identity."""
-    mask = [getattr(nd, "_detached", False) for nd in inputs]
+def _detach_mask(inputs):
+    return tuple(bool(getattr(nd, "_detached", False)) for nd in inputs)
+
+
+def _wrap_masked(fn, mask):
+    """Stop gradient flow through the mask-selected arguments (the single
+    implementation both the forward wrapper and the compiled backward use,
+    so detach semantics can't drift between them)."""
     if not any(mask):
         return fn
     import jax
@@ -242,6 +285,12 @@ def _wrap_detached(fn, inputs):
         ])
 
     return wrapped
+
+
+def _wrap_detached(fn, inputs):
+    """Stop gradient flow through inputs marked detach()ed, without copying
+    their buffers or changing their tape identity."""
+    return _wrap_masked(fn, _detach_mask(inputs))
 
 
 def invoke_by_name(name: str, inputs, out=None, **attrs):

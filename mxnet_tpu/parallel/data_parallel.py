@@ -114,13 +114,18 @@ class DataParallelStep:
                  rules: Optional[ShardingRules] = None,
                  batch_axes: Sequence[str] = ("dp", "sp"),
                  seq_axis: Optional[int] = None,
-                 donate: bool = True):
+                 donate: bool = True, remat: bool = False):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
         the sp axis size; otherwise (e.g. NCHW/NHWC image batches) the
         batch dim is sharded over dp*sp as plain data parallelism.  Pass
-        seq_axis=1 to force SP, seq_axis=-1 to disable it."""
+        seq_axis=1 to force SP, seq_axis=-1 to disable it.
+
+        remat: rematerialize the forward in the backward pass
+        (jax.checkpoint over the block apply) — trades ~1 extra forward of
+        FLOPs for not storing activations, the HBM lever for large
+        per-chip batches (reference analog: MXNet memonger/mirror)."""
         import jax
 
         from ..context import current_context
@@ -148,6 +153,7 @@ class DataParallelStep:
         self._rescale = opt_params.get("rescale_grad", 1.0)
         self._optimizer = optimizer
         self._donate = donate
+        self._remat = remat
 
         ctx = current_context()
         self._ctx = ctx
@@ -213,6 +219,23 @@ class DataParallelStep:
         from jax.sharding import NamedSharding, PartitionSpec
 
         apply_fn = self._apply
+        if self._remat:
+            # jax.checkpoint only accepts JAX-typed outputs: strip the
+            # static aux NAMES (strings) out of the rematerialized region
+            # and re-pair them outside — they're trace-stable for a block
+            base, names_cell = apply_fn, []
+
+            def _arrays_only(params, key, *xs):
+                out, aux = base(params, key, *xs)
+                if not names_cell:
+                    names_cell.append([n for n, _ in aux])
+                return out, [v for _, v in aux]
+
+            ck = jax.checkpoint(_arrays_only)
+
+            def apply_fn(params, key, *xs):
+                out, vals = ck(params, key, *xs)
+                return out, list(zip(names_cell[0], vals))
         loss_fn = self.loss_fn
         opt = self._optimizer
         lr, momentum, wd, rescale = (self._lr, self._momentum, self._wd,
