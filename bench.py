@@ -152,14 +152,11 @@ def main():
 
 def _common_setup(platform):
     on_tpu = platform == "tpu"
-    if not on_tpu:
-        # JAX_PLATFORMS=cpu in the env is NOT enough: the axon shim
-        # intercepts backend lookup and can still hang on the relay.
-        # jax.config.update before first device touch reliably pins cpu.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
     import mxnet_tpu as mx
+
+    if not on_tpu:
+        # JAX_PLATFORMS=cpu in the env is NOT enough — see pin_platform
+        mx.context.pin_platform("cpu")
 
     mx.random.seed(0)
     ctx = mx.tpu() if on_tpu else mx.cpu()

@@ -30,11 +30,15 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--device", default="auto", choices=["auto", "cpu"])
     args = ap.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
 
     import jax
+
+    if args.device == "cpu":
+        mx.context.pin_platform("cpu")
 
     mx.random.seed(0)
     n_dev = args.dp * args.tp

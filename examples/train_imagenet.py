@@ -33,9 +33,15 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--device", default="auto", choices=["auto", "cpu"],
+                    help="cpu pins the CPU backend via jax.config (the "
+                         "JAX_PLATFORMS env var is not reliable under a "
+                         "TPU-relay shim); auto uses the default platform")
     args = ap.parse_args()
     if args.steps < 1:
         raise SystemExit("--steps must be >= 1")
+    if args.device == "cpu":
+        mx.context.pin_platform("cpu")
 
     shape = tuple(int(s) for s in args.image_shape.split(","))
     mx.random.seed(0)

@@ -55,7 +55,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--device", default="auto", choices=["auto", "cpu"])
     args = ap.parse_args()
+    if args.device == "cpu":
+        mx.context.pin_platform("cpu")
 
     mx.random.seed(42)
     ctx = mx.current_context()

@@ -31,6 +31,7 @@ __all__ = [
     "current_context",
     "num_gpus",
     "num_tpus",
+    "pin_platform",
 ]
 
 _ACCEL_TYPES = ("tpu", "gpu")
@@ -40,6 +41,17 @@ def _jax():
     import jax
 
     return jax
+
+
+def pin_platform(name: str) -> None:
+    """Pin the jax backend platform (e.g. "cpu") before first device touch.
+
+    The ONE sanctioned mechanism: setting the JAX_PLATFORMS env var is NOT
+    reliable when a TPU-relay shim intercepts backend lookup (it can still
+    hang on a dead relay); jax.config.update always takes effect as long as
+    no device has been touched yet.  Used by examples, bench.py and tools.
+    """
+    _jax().config.update("jax_platforms", name)
 
 
 class Context:

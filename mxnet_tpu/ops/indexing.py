@@ -22,9 +22,20 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register("pick")
 def pick(x, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
-    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
-    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+    """Reference PickOpShape (src/operator/tensor/broadcast_reduce_op.h):
+    the index may have the axis dim REMOVED or kept as size 1 — gluon's
+    SoftmaxCrossEntropyLoss feeds (B,1) labels from ImageRecordIter and
+    (B,) labels from NDArrayIter through the same op."""
+    ax = axis % x.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[ax])
+    else:
+        idx = jnp.clip(idx, 0, x.shape[ax] - 1)
+    if idx.ndim == x.ndim - 1:
+        idx = jnp.expand_dims(idx, ax)
+    picked = jnp.take_along_axis(x, idx, axis=ax)
+    return picked if keepdims else jnp.squeeze(picked, axis=ax)
 
 
 @register("gather_nd")

@@ -176,6 +176,13 @@ def test_legacy_index_ops():
     mhs = nd.array(np.array([-1, -2, -3, -4], np.float32))
     filled = nd.fill_element_0index(lhs, mhs, rhs).asnumpy()
     assert filled[0, 0] == -1 and filled[1, 2] == -2
+    # pick accepts the axis dim removed OR kept as size 1 (reference
+    # PickOpShape) — gluon SoftmaxCE feeds (B,1) ImageRecordIter labels
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    flat = nd.pick(x, nd.array(np.array([1, 2], np.float32)), axis=1)
+    kept = nd.pick(x, nd.array(np.array([[1], [2]], np.float32)), axis=1)
+    np.testing.assert_array_equal(flat.asnumpy(), [1, 5])
+    np.testing.assert_array_equal(kept.asnumpy(), [1, 5])
     tgt = nd.zeros((2, 3))
     ret = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)), tgt)
     np.testing.assert_array_equal(ret.asnumpy(), [[0, 1, 0], [1, 0, 0]])
