@@ -79,6 +79,46 @@ def test_bert_tp_dp_step():
     assert "tp" in str(sh.spec), f"expected tp sharding, got {sh.spec}"
 
 
+def test_kvstore_device_collective_reduce():
+    """Distinct-device pushes aggregate via the compiled psum all-reduce
+    (replicated result, no lead-device funnel — r3 weak #4); semantics are
+    identical to the staged-sum path."""
+    kv = mx.kvstore.create("device")
+    ctxs = [mx.cpu(i) for i in range(8)]
+    shape = (3, 4)
+    kv.init("w", nd.zeros(shape, ctx=ctxs[0]))
+    grads = [nd.ones(shape, ctx=c) * (i + 1) for i, c in enumerate(ctxs)]
+    kv.push("w", grads)
+    # collective path actually taken: the stored value is mesh-replicated
+    stored = kv._store["w"]
+    assert len(stored._data.sharding.device_set) == 8, stored._data.sharding
+    outs = [nd.zeros(shape, ctx=c) for c in ctxs]
+    kv.pull("w", outs)
+    expect = np.full(shape, sum(range(1, 9)), np.float32)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), expect)
+
+    # updater path: server-side optimizer against the single-device store
+    kv2 = mx.kvstore.create("device")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv2.init(0, nd.ones(shape, ctx=ctxs[0]))
+    kv2.push(0, [nd.ones(shape, ctx=c) for c in ctxs])  # grad sum = 8
+    w = nd.zeros(shape, ctx=ctxs[0])
+    kv2.pull(0, w)
+    np.testing.assert_allclose(w.asnumpy(), np.full(shape, 1.0 - 0.1 * 8),
+                               rtol=1e-6)
+
+    # updater installed AFTER a replicated non-updater push: the store
+    # value is mesh-replicated at that point and must be localized before
+    # the eager updater mixes device sets (r4 review finding)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push("w", [nd.ones(shape, ctx=c) for c in ctxs])
+    w2 = nd.zeros(shape, ctx=ctxs[3])
+    kv.pull("w", w2)
+    np.testing.assert_allclose(
+        w2.asnumpy(), expect - 0.1 * 8, rtol=1e-6)
+
+
 def test_kvstore_semantics():
     kv = mx.kvstore.create("device")
     kv.init(3, nd.ones((2, 2)))
