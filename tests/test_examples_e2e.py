@@ -1,0 +1,51 @@
+"""End-to-end example-script smoke (subprocess, CPU-pinned).
+
+The examples are the BASELINE acceptance drivers; running one of them
+through the REAL input pipeline catches integration bugs unit tests miss
+(r4: the pick/(B,1)-label crash only surfaced driving train_imagenet
+--rec).  Reference analog: tests/nightly tutorial/example execution.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_rec(tmp_path, n=64, size=48):
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "train.rec")
+    idx = str(tmp_path / "train.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, (size, size, 3), np.uint8)
+        h = recordio.IRHeader(0, float(i % 5), i, 0)
+        w.write_idx(i, recordio.pack_img(h, arr, quality=80))
+    w.close()
+    return rec
+
+
+def test_train_imagenet_rec_e2e(tmp_path):
+    rec = _make_rec(tmp_path)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "train_imagenet.py"),
+         "--device", "cpu", "--rec", rec, "--model", "resnet18_v1",
+         "--batch-size", "8", "--image-shape", "3,32,32",
+         "--num-classes", "5", "--steps", "3"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "final loss" in res.stdout, res.stdout[-500:]
+
+
+def test_train_mnist_e2e():
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "train_mnist.py"),
+         "--device", "cpu"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "MNIST example OK" in res.stdout
