@@ -31,10 +31,37 @@ def flash_attention_op(q, k, v, causal=False, sm_scale=None):
     """Fused softmax(q k^T) v.  q/k/v: (N, L, D) or (B, H, L, D).
 
     Pallas blockwise kernel on TPU; dense jnp composition elsewhere
-    (XLA still fuses the chain, it just materialises scores).
+    (XLA still fuses the chain, it just materialises scores).  Inside a
+    DataParallelStep(ring_attention=True) trace with an active sp axis,
+    3-d inputs route through the sequence-parallel ring kernel
+    (parallel/ring.py): K/V rotate over ICI via ppermute and the full
+    (L, L) score matrix never exists on any device.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    from ..parallel import ring_scope
+
+    scope = ring_scope()
+    if scope is not None and q.ndim == 3:
+        mesh, batch_axes = scope
+        shape = dict(mesh.shape)
+        sp = shape.get("sp", 1)
+        n_batch = 1
+        for a in batch_axes:
+            n_batch *= shape.get(a, 1)
+        # route to the ring only when shard_map's divisibility holds for
+        # EVERY operand dim it shards (self-attention, seq and batch dims
+        # divisible) — anything else silently keeps the dense/Pallas path
+        # that runs the same shapes without the scope
+        if (sp > 1
+                and q.shape[1] == k.shape[1] == v.shape[1]
+                and q.shape[1] % sp == 0
+                and q.shape[0] % max(n_batch, 1) == 0):
+            from ..parallel.ring import ring_self_attention
+
+            return ring_self_attention(
+                mesh, q, k, v, causal=causal, sm_scale=sm_scale,
+                batch_axes=batch_axes or None)
     from . import pallas as _pk
 
     if _pk.enabled() and _pk.use_compiled():

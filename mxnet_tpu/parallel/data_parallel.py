@@ -114,7 +114,8 @@ class DataParallelStep:
                  rules: Optional[ShardingRules] = None,
                  batch_axes: Sequence[str] = ("dp", "sp"),
                  seq_axis: Optional[int] = None,
-                 donate: bool = True, remat: bool = False):
+                 donate: bool = True, remat: bool = False,
+                 ring_attention: bool = False):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
@@ -125,7 +126,13 @@ class DataParallelStep:
         remat: rematerialize the forward in the backward pass
         (jax.checkpoint over the block apply) — trades ~1 extra forward of
         FLOPs for not storing activations, the HBM lever for large
-        per-chip batches (reference analog: MXNet memonger/mirror)."""
+        per-chip batches (reference analog: MXNet memonger/mirror).
+
+        ring_attention: with an active sp>1 axis, fused-attention ops in
+        the model lower to the ring kernel (K/V rotating over ICI via
+        ppermute, online softmax) instead of GSPMD's K/V all-gather —
+        per-device attention memory stays O((L/sp)^2) for long
+        sequences."""
         import jax
 
         from ..context import current_context
@@ -154,6 +161,7 @@ class DataParallelStep:
         self._optimizer = optimizer
         self._donate = donate
         self._remat = remat
+        self._ring = ring_attention
 
         ctx = current_context()
         self._ctx = ctx
@@ -331,8 +339,25 @@ class DataParallelStep:
 
         from .. import profiler
 
+        from .scope import ring_attention_scope
+
+        import contextlib
+
+        # ring routing only when THIS step actually sequence-sharded the
+        # inputs (honors seq_axis=-1 / the auto-detect decline); the
+        # batch-dim axes travel with the scope so the ring's shard_map
+        # spec matches the activations' real sharding (dp batch + tp
+        # heads on the collapsed B*H dim)
+        if self._ring and sp_active:
+            dim0_axes = tuple(
+                a for a in (tuple(x for x in self._batch_axes if x != "sp")
+                            + ("tp",))
+                if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
+            ring_cm = ring_attention_scope(self.mesh, dim0_axes)
+        else:
+            ring_cm = contextlib.nullcontext()
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
-        with _pk.compute_on(mesh_platform):
+        with _pk.compute_on(mesh_platform), ring_cm:
             run = self._jitted
             if profiler.is_recording():
                 run = (lambda *a: profiler.timed_call(

@@ -143,16 +143,19 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ring_self_attention(mesh, q, k, v, causal: bool = False,
-                        sm_scale: Optional[float] = None, axis: str = "sp"):
+                        sm_scale: Optional[float] = None, axis: str = "sp",
+                        batch_axes: Optional[tuple] = None):
     """Convenience: shard_map-wrapped ring attention over mesh axis `axis`.
 
     q/k/v are global (N, L, D) arrays; the sequence dim is sharded over
-    `axis`, N replicated over it.  Returns the global (N, L, D) output.
+    `axis`, N sharded over `batch_axes` (replicated when None).  Returns
+    the global (N, L, D) output.  The single shard_map wrapper — callers
+    (incl. the _contrib_flash_attention ring route) go through here.
     """
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    spec = P(None, axis, None)
+    spec = P(tuple(batch_axes) if batch_axes else None, axis, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
                            sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -1,0 +1,37 @@
+"""Trace-time parallelism scopes.
+
+DataParallelStep(ring_attention=True) activates `ring_attention_scope`
+around its jit trace/execution — only when its own SP gating decided the
+sequence dim really is sharded; the fused-attention op
+(`_contrib_flash_attention`) consults `ring_scope()` and lowers to the
+ring kernel (parallel/ring.py) instead of letting GSPMD all-gather K/V —
+the long-context memory win.  The scope carries the step's batch-dim
+axes so the shard_map spec matches the activations' actual sharding.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Tuple
+
+_state = threading.local()
+
+
+def ring_scope() -> Optional[Tuple]:
+    """(mesh, batch_axes) of the innermost active scope, or None."""
+    return getattr(_state, "scope", None)
+
+
+def ring_scope_mesh():
+    s = ring_scope()
+    return None if s is None else s[0]
+
+
+@contextlib.contextmanager
+def ring_attention_scope(mesh, batch_axes: Tuple[str, ...] = ()):
+    prev = getattr(_state, "scope", None)
+    _state.scope = (mesh, tuple(batch_axes))
+    try:
+        yield
+    finally:
+        _state.scope = prev
