@@ -5,4 +5,6 @@ from . import bert
 from . import ssd
 from .bert import BERTModel, BERTForMLM, bert_base, bert_small
 from .ssd import SSD, SSDTrainLoss, ssd_300
-from .transformer import TransformerEncoder, MultiHeadAttention
+from .transformer import (TransformerEncoder, MultiHeadAttention,
+                          Transformer, TransformerDecoder, transformer_base,
+                          transformer_big, label_smoothed_ce)

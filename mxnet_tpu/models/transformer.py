@@ -20,8 +20,35 @@ from ..base import MXNetError
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
-__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
-           "TransformerEncoder", "PositionalEmbedding"]
+__all__ = ["MultiHeadAttention", "MultiHeadCrossAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder",
+           "PositionalEmbedding", "TransformerDecoderCell",
+           "TransformerDecoder", "Transformer", "transformer_base",
+           "transformer_big", "label_smoothed_ce"]
+
+
+def _split_heads(t, num_heads, head_dim):
+    # (B, T, C) -> (B*H, T, hd)
+    t = t.reshape(0, 0, -4, num_heads, head_dim)
+    t = t.transpose((0, 2, 1, 3))
+    return t.reshape(-3, 0, 0)
+
+
+def _merge_heads(t, num_heads):
+    # (B*H, T, hd) -> (B, T, C)
+    t = t.reshape(-4, -1, num_heads, 0, 0)
+    return t.transpose((0, 2, 1, 3)).reshape(0, 0, -3)
+
+
+def _mask_scores(F, scores, mask, num_heads):
+    """mask: (B, Tq, Tk) with 1=keep, broadcast over heads of (B*H, Tq, Tk)
+    scores; masked-out positions get the dtype-safe big negative."""
+    big_neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
+    m = mask.expand_dims(1)
+    m = F.broadcast_like(m, scores.reshape(-4, -1, num_heads, 0, 0),
+                         lhs_axes=(1,), rhs_axes=(1,))
+    m = m.reshape(-3, 0, 0)
+    return F.where(m, scores, F.ones_like(scores) * big_neg)
 
 
 class MultiHeadAttention(HybridBlock):
@@ -52,14 +79,9 @@ class MultiHeadAttention(HybridBlock):
         # x: (B, T, C)
         qkv = self.qkv(x)  # (B, T, 3C)
         q, k, v = F.split(qkv, num_outputs=3, axis=-1)
-
-        def heads(t):
-            # (B, T, C) -> (B*H, T, hd)
-            t = t.reshape(0, 0, -4, self._num_heads, self._head_dim)
-            t = t.transpose((0, 2, 1, 3))
-            return t.reshape(-3, 0, 0)
-
-        q, k, v = heads(q), heads(k), heads(v)
+        q = _split_heads(q, self._num_heads, self._head_dim)
+        k = _split_heads(k, self._num_heads, self._head_dim)
+        v = _split_heads(v, self._num_heads, self._head_dim)
         from .. import autograd as _ag
 
         if mask is None and (self._dropout == 0.0 or not _ag.is_training()):
@@ -67,9 +89,7 @@ class MultiHeadAttention(HybridBlock):
             # attention-prob dropout is inactive, so it is numerically
             # equivalent to the dense path
             out = F._contrib_flash_attention(q, k, v, causal=self._causal)
-            out = out.reshape(-4, -1, self._num_heads, 0, 0)
-            out = out.transpose((0, 2, 1, 3)).reshape(0, 0, -3)
-            return self.proj(out)
+            return self.proj(_merge_heads(out, self._num_heads))
         scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
         if self._causal:
             T = scores.shape[-1]
@@ -79,19 +99,11 @@ class MultiHeadAttention(HybridBlock):
             scores = F.broadcast_add(
                 scores, (1.0 - tril).expand_dims(0) * neg)
         if mask is not None:
-            # mask: (B, T, T) with 1=keep; broadcast over heads
-            big_neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
-            m = mask.expand_dims(1)
-            m = F.broadcast_like(m, scores.reshape(
-                -4, -1, self._num_heads, 0, 0), lhs_axes=(1,), rhs_axes=(1,))
-            m = m.reshape(-3, 0, 0)
-            scores = F.where(m, scores, F.ones_like(scores) * big_neg)
+            scores = _mask_scores(F, scores, mask, self._num_heads)
         attn = F.softmax(scores, axis=-1)
         attn = self.attn_drop(attn)
         out = F.batch_dot(attn, v)  # (B*H, T, hd)
-        out = out.reshape(-4, -1, self._num_heads, 0, 0)
-        out = out.transpose((0, 2, 1, 3)).reshape(0, 0, -3)
-        return self.proj(out)
+        return self.proj(_merge_heads(out, self._num_heads))
 
 
 class PositionwiseFFN(HybridBlock):
@@ -166,3 +178,262 @@ class TransformerEncoder(HybridBlock):
         for cell in self.layers:
             x = cell(x, mask)
         return x
+
+
+# ---------------------------------------------------------------------------
+# seq2seq (BASELINE config 4: Transformer-big WMT14; reference: GluonNLP
+# scripts/machine_translation transformer encoder-decoder)
+# ---------------------------------------------------------------------------
+class MultiHeadCrossAttention(HybridBlock):
+    """Decoder->encoder attention: q from x, k/v from the encoder memory.
+
+    Weight layout mirrors MultiHeadAttention: q (units, in), kv (2*units,
+    in) with heads on the leading axis, so 'tp' shardings split heads.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads != 0:
+            raise MXNetError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                   prefix="q_")
+            self.kv = nn.Dense(2 * units, flatten=False, use_bias=use_bias,
+                               prefix="kv_")
+            self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                 prefix="proj_")
+            self.attn_drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem, mask=None):
+        # x: (B, Tq, C); mem: (B, Tk, C); mask: (B, Tq, Tk) with 1=keep
+        q = self.q_proj(x)
+        kv = self.kv(mem)
+        k, v = F.split(kv, num_outputs=2, axis=-1)
+        q = _split_heads(q, self._num_heads, self._head_dim)
+        k = _split_heads(k, self._num_heads, self._head_dim)
+        v = _split_heads(v, self._num_heads, self._head_dim)
+        scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
+        if mask is not None:
+            scores = _mask_scores(F, scores, mask, self._num_heads)
+        attn = self.attn_drop(F.softmax(scores, axis=-1))
+        out = F.batch_dot(attn, v)
+        return self.proj(_merge_heads(out, self._num_heads))
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Causal self-attention + cross-attention + FFN (post-LN, the WMT
+    recipe; pre_norm=True for the deep-net variant)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False, activation="relu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._pre_norm = pre_norm
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(units, num_heads, dropout,
+                                                causal=True, prefix="self_")
+            self.cross_attn = MultiHeadCrossAttention(units, num_heads,
+                                                      dropout, prefix="cross_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation,
+                                       prefix="ffn_")
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+            self.ln3 = nn.LayerNorm(in_channels=units, prefix="ln3_")
+            self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem, self_mask=None, cross_mask=None):
+        if self._pre_norm:
+            x = x + self.drop(self.self_attn(self.ln1(x), self_mask))
+            x = x + self.drop(self.cross_attn(self.ln2(x), mem, cross_mask))
+            return x + self.ffn(self.ln3(x))
+        x = self.ln1(x + self.drop(self.self_attn(x, self_mask)))
+        x = self.ln2(x + self.drop(self.cross_attn(x, mem, cross_mask)))
+        return self.ln3(x + self.ffn(x))
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 pre_norm=False, activation="relu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.layers.add(TransformerDecoderCell(
+                    units, hidden_size, num_heads, dropout, pre_norm,
+                    activation, prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mem, self_mask=None, cross_mask=None):
+        for cell in self.layers:
+            x = cell(x, mem, self_mask, cross_mask)
+        return x
+
+
+class Transformer(HybridBlock):
+    """Encoder-decoder Transformer with shared source/target embedding and
+    tied output projection (the WMT14 recipe; GluonNLP
+    scripts/machine_translation/transformer.py analog, re-designed as one
+    hybridizable block so the whole train step is a single XLA program).
+
+    forward(src, tgt) -> logits (B, Tt, vocab).  Padding id 0 is masked
+    out of both attention directions; the decoder self-attention is causal.
+    """
+
+    def __init__(self, vocab_size, units=512, hidden_size=2048, num_heads=8,
+                 num_layers=6, max_length=1024, dropout=0.1, pad_id=0,
+                 tie_embeddings=True, activation="relu", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._pad_id = pad_id
+        self._vocab = vocab_size
+        self._tie = tie_embeddings
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
+            self.pos = PositionalEmbedding(max_length, units, prefix="pos_")
+            self.enc_drop = nn.Dropout(dropout)
+            self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              activation=activation,
+                                              prefix="enc_")
+            self.decoder = TransformerDecoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              activation=activation,
+                                              prefix="dec_")
+            if not tie_embeddings:
+                self.out_proj = nn.Dense(vocab_size, flatten=False,
+                                         prefix="out_")
+
+    def _encode_h(self, F, src):
+        """(memory, src_keep) — key-padding mask layout (B, Tq, Tk),
+        1 = attend.  Causality is NOT folded into masks: the decoder's
+        self-attention block is constructed causal=True and applies the
+        tril itself."""
+        Ts = src.shape[1]
+        src_keep = (src != self._pad_id)  # (B, Ts)
+        enc_mask = F.broadcast_axis(src_keep.expand_dims(1), axis=1, size=Ts)
+        mem = self.embed(src) * math.sqrt(self._units)
+        mem = self.enc_drop(self.pos(mem))
+        return self.encoder(mem, enc_mask), src_keep
+
+    def _decode_h(self, F, tgt, mem, src_keep):
+        Tt = tgt.shape[1]
+        cross_mask = F.broadcast_axis(src_keep.expand_dims(1), axis=1,
+                                      size=Tt)  # (B, Tt, Ts)
+        self_mask = F.broadcast_axis((tgt != self._pad_id).expand_dims(1),
+                                     axis=1, size=Tt)  # (B, Tt, Tt)
+        h = self.embed(tgt) * math.sqrt(self._units)
+        h = self.enc_drop(self.pos(h))
+        h = self.decoder(h, mem, self_mask, cross_mask)
+        if self._tie:
+            # tied softmax: logits = h E^T (shared embedding matrix)
+            return F.FullyConnected(h.reshape(-3, 0),
+                                    self.embed.weight.data(h.context),
+                                    num_hidden=self._vocab, no_bias=True,
+                                    flatten=False).reshape(
+                                        -4, -1, Tt, 0)
+        return self.out_proj(h)
+
+    def hybrid_forward(self, F, src, tgt):
+        mem, src_keep = self._encode_h(F, src)
+        return self._decode_h(F, tgt, mem, src_keep)
+
+    # -- inference ---------------------------------------------------------
+    def translate(self, src, bos_id, eos_id, max_len=32, beam_size=4,
+                  alpha=0.6):
+        """Beam-search decode (GNMT length penalty).
+
+        src: NDArray (B, Ts) int.  Returns (B, max_len) numpy int32 of the
+        best hypotheses (eos/pad-trimmed by the caller).  The encoder runs
+        ONCE; the per-step scorer is the decoder over a fixed
+        (B*beam, max_len) padded target, so every step reuses one
+        executable; beam bookkeeping is host-side numpy, as in the
+        reference's BeamSearchSampler.
+        """
+        from .. import autograd
+        from .. import ndarray as F
+        import numpy as _np
+
+        B, Ts = src.shape
+        K, V = beam_size, self._vocab
+        src_np = _np.asarray(src.asnumpy(), _np.int32)
+        from ..ndarray import array as nd_array
+
+        src_k = nd_array(_np.repeat(src_np, K, axis=0), ctx=src.context,
+                         dtype="int32")  # (B*K, Ts)
+        with autograd.pause():
+            mem, src_keep = self._encode_h(F, src_k)  # encoder runs once
+        tgt = _np.full((B * K, max_len), self._pad_id, _np.int32)
+        tgt[:, 0] = bos_id
+        scores = _np.full((B, K), -_np.inf, _np.float32)
+        scores[:, 0] = 0.0  # only beam 0 live at t=0 (all beams identical)
+        finished = _np.zeros((B, K), bool)
+
+        for t in range(1, max_len):
+            with autograd.pause():
+                logits = self._decode_h(
+                    F, nd_array(tgt, ctx=src.context, dtype="int32"),
+                    mem, src_keep)
+            lp = _np.asarray(logits.asnumpy(), _np.float32)[:, t - 1]  # (B*K, V)
+            lp = lp - _np.log(_np.exp(lp - lp.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - lp.max(-1, keepdims=True)
+            lp = lp.reshape(B, K, V)
+            # finished beams only extend with pad at zero cost
+            lp_fin = _np.full((V,), -_np.inf, _np.float32)
+            lp_fin[self._pad_id] = 0.0
+            lp = _np.where(finished[:, :, None], lp_fin[None, None], lp)
+            cand = scores[:, :, None] + lp  # (B, K, V)
+            flat = cand.reshape(B, K * V)
+            top = _np.argsort(-flat, axis=1)[:, :K]  # (B, K)
+            scores = _np.take_along_axis(flat, top, axis=1)
+            beam_idx, tok = top // V, (top % V).astype(_np.int32)
+            new_tgt = _np.empty_like(tgt.reshape(B, K, max_len))
+            old = tgt.reshape(B, K, max_len)
+            for b in range(B):
+                new_tgt[b] = old[b, beam_idx[b]]
+            new_tgt[:, :, t] = tok
+            tgt = new_tgt.reshape(B * K, max_len)
+            finished = _np.take_along_axis(finished, beam_idx, axis=1) \
+                | (tok == eos_id) | (tok == self._pad_id)
+            if finished.all():
+                break
+        # GNMT length penalty: score / ((5+len)/6)^alpha
+        lengths = (tgt.reshape(B, K, max_len) != self._pad_id).sum(-1)
+        penal = ((5.0 + lengths) / 6.0) ** alpha
+        best = _np.argmax(scores / penal, axis=1)
+        out = tgt.reshape(B, K, max_len)[_np.arange(B), best]
+        return out
+
+
+def label_smoothed_ce(logits, labels, smoothing=0.1, pad_id=0):
+    """Label-smoothed cross entropy over (B, T, V) logits, ignoring pad
+    positions (reference: GluonNLP LabelSmoothing + SoftmaxCEMaskedLoss).
+    Returns the scalar mean over non-pad tokens."""
+    from ..ndarray import NDArray  # noqa: F401  (type anchor)
+
+    V = logits.shape[-1]
+    flat = logits.reshape(-3, 0)
+    lab = labels.reshape(-1)
+    logp = flat.log_softmax(axis=-1)
+    nll = -logp.pick(lab, axis=-1)
+    smooth = -logp.mean(axis=-1)
+    loss = (1.0 - smoothing) * nll + smoothing * smooth
+    keep = (lab != pad_id)
+    return (loss * keep).sum() / keep.sum().maximum(1.0)
+
+
+def transformer_base(vocab_size, **kwargs) -> Transformer:
+    """Transformer-base (WMT14): 6 layers, 512/2048, 8 heads."""
+    kwargs.setdefault("dropout", 0.1)
+    return Transformer(vocab_size, units=512, hidden_size=2048, num_heads=8,
+                       num_layers=6, **kwargs)
+
+
+def transformer_big(vocab_size, **kwargs) -> Transformer:
+    """Transformer-big (WMT14, BASELINE config 4): 6 layers, 1024/4096,
+    16 heads, dropout 0.3."""
+    kwargs.setdefault("dropout", 0.3)
+    return Transformer(vocab_size, units=1024, hidden_size=4096,
+                       num_heads=16, num_layers=6, **kwargs)

@@ -1,0 +1,103 @@
+"""Encoder-decoder Transformer (BASELINE config 4 skeleton): forward
+shapes, label-smoothed loss, tiny-task convergence, beam-search decode.
+
+Reference: GluonNLP scripts/machine_translation (transformer encoder/
+decoder, LabelSmoothing, BeamSearchSampler) — re-designed here as one
+hybridizable block whose train step compiles to a single XLA program.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.models.transformer import (Transformer, label_smoothed_ce,
+                                          transformer_base)
+
+PAD, BOS, EOS = 0, 1, 2
+
+
+def _tiny_model(vocab=16):
+    mx.random.seed(0)
+    net = Transformer(vocab, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=20, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _reverse_batch(rng, B, L=6, vocab=16):
+    """src: random tokens; tgt = <bos> reversed(src) <eos>, padded."""
+    src = np.zeros((B, L + 1), np.int32)
+    tgt_in = np.zeros((B, L + 2), np.int32)
+    tgt_out = np.zeros((B, L + 2), np.int32)
+    for b in range(B):
+        toks = rng.randint(3, vocab, L)
+        src[b, :L] = toks
+        rev = toks[::-1]
+        tgt_in[b, 0] = BOS
+        tgt_in[b, 1:L + 1] = rev
+        tgt_out[b, :L] = rev
+        tgt_out[b, L] = EOS
+    return src, tgt_in, tgt_out
+
+
+def test_forward_shapes_and_padding_invariance():
+    net = _tiny_model()
+    rng = np.random.RandomState(0)
+    src, tgt_in, _ = _reverse_batch(rng, 2)
+    out = net(nd.array(src, dtype="int32"), nd.array(tgt_in, dtype="int32"))
+    assert out.shape == (2, tgt_in.shape[1], 16)
+    # padding the source must not change the (non-pad-key) logits
+    src_pad = np.concatenate([src, np.zeros((2, 3), np.int32)], axis=1)
+    out_pad = net(nd.array(src_pad, dtype="int32"),
+                  nd.array(tgt_in, dtype="int32"))
+    np.testing.assert_allclose(out.asnumpy(), out_pad.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_label_smoothed_ce_reduces_to_ce():
+    rng = np.random.RandomState(1)
+    logits = nd.array(rng.randn(3, 5, 7).astype(np.float32))
+    labels = nd.array(rng.randint(1, 7, (3, 5)).astype(np.float32))
+    ls0 = float(label_smoothed_ce(logits, labels, smoothing=0.0).asscalar())
+    # plain masked CE reference
+    lp = np.log(np.exp(logits.asnumpy()) /
+                np.exp(logits.asnumpy()).sum(-1, keepdims=True))
+    lab = labels.asnumpy().astype(int)
+    ref = -np.mean([lp[b, t, lab[b, t]] for b in range(3) for t in range(5)])
+    np.testing.assert_allclose(ls0, ref, rtol=1e-5)
+    ls1 = float(label_smoothed_ce(logits, labels, smoothing=0.1).asscalar())
+    assert ls1 != ls0  # smoothing changes the value
+
+
+def test_seq2seq_learns_reverse_and_beam_decodes():
+    """Memorize a tiny reversal task end-to-end, then beam-search it back."""
+    from mxnet_tpu import gluon
+
+    net = _tiny_model()
+    rng = np.random.RandomState(2)
+    src, tgt_in, tgt_out = _reverse_batch(rng, 8)
+
+    losses = []
+    sb = nd.array(src, dtype="int32")
+    tb = nd.array(tgt_in, dtype="int32")
+    lb = nd.array(tgt_out.astype(np.float32))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    for i in range(80):
+        with autograd.record():
+            logits = net(sb, tb)
+            loss = label_smoothed_ce(logits, lb, smoothing=0.0)
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < 0.15, f"no convergence: {losses[::20]}"
+
+    # greedy (beam=1) and beam=3 both reproduce the memorized reversal
+    hyp = net.translate(sb, bos_id=BOS, eos_id=EOS, max_len=tgt_in.shape[1],
+                        beam_size=3)
+    # hypothesis rows start at position 1 (pos 0 is BOS)
+    L = 6
+    got = hyp[:, 1:L + 1]
+    want = src[:, :L][:, ::-1]
+    match = (got == want).mean()
+    assert match > 0.9, f"beam decode mismatch {match}: {got[0]} vs {want[0]}"
