@@ -361,10 +361,13 @@ class Transformer(HybridBlock):
         src_np = _np.asarray(src.asnumpy(), _np.int32)
         from ..ndarray import array as nd_array
 
-        src_k = nd_array(_np.repeat(src_np, K, axis=0), ctx=src.context,
-                         dtype="int32")  # (B*K, Ts)
         with autograd.pause():
-            mem, src_keep = self._encode_h(F, src_k)  # encoder runs once
+            # encode the (B, Ts) batch ONCE, then tile memory for beams —
+            # 1/K the encoder FLOPs of encoding the repeated batch
+            src_1 = nd_array(src_np, ctx=src.context, dtype="int32")
+            mem, src_keep = self._encode_h(F, src_1)
+            mem = F.repeat(mem, repeats=K, axis=0)          # (B*K, Ts, C)
+            src_keep = F.repeat(src_keep, repeats=K, axis=0)  # (B*K, Ts)
         tgt = _np.full((B * K, max_len), self._pad_id, _np.int32)
         tgt[:, 0] = bos_id
         scores = _np.full((B, K), -_np.inf, _np.float32)
@@ -376,7 +379,11 @@ class Transformer(HybridBlock):
                 logits = self._decode_h(
                     F, nd_array(tgt, ctx=src.context, dtype="int32"),
                     mem, src_keep)
-            lp = _np.asarray(logits.asnumpy(), _np.float32)[:, t - 1]  # (B*K, V)
+                # slice the one needed position on-device: the host copy is
+                # (B*K, V), not (B*K, max_len, V)
+                step_logits = F.slice_axis(logits, axis=1, begin=t - 1,
+                                           end=t).reshape(0, -1)
+            lp = _np.asarray(step_logits.asnumpy(), _np.float32)  # (B*K, V)
             lp = lp - _np.log(_np.exp(lp - lp.max(-1, keepdims=True)).sum(
                 -1, keepdims=True)) - lp.max(-1, keepdims=True)
             lp = lp.reshape(B, K, V)
@@ -389,10 +396,8 @@ class Transformer(HybridBlock):
             top = _np.argsort(-flat, axis=1)[:, :K]  # (B, K)
             scores = _np.take_along_axis(flat, top, axis=1)
             beam_idx, tok = top // V, (top % V).astype(_np.int32)
-            new_tgt = _np.empty_like(tgt.reshape(B, K, max_len))
-            old = tgt.reshape(B, K, max_len)
-            for b in range(B):
-                new_tgt[b] = old[b, beam_idx[b]]
+            new_tgt = _np.take_along_axis(tgt.reshape(B, K, max_len),
+                                          beam_idx[:, :, None], axis=1)
             new_tgt[:, :, t] = tok
             tgt = new_tgt.reshape(B * K, max_len)
             finished = _np.take_along_axis(finished, beam_idx, axis=1) \
@@ -411,9 +416,6 @@ def label_smoothed_ce(logits, labels, smoothing=0.1, pad_id=0):
     """Label-smoothed cross entropy over (B, T, V) logits, ignoring pad
     positions (reference: GluonNLP LabelSmoothing + SoftmaxCEMaskedLoss).
     Returns the scalar mean over non-pad tokens."""
-    from ..ndarray import NDArray  # noqa: F401  (type anchor)
-
-    V = logits.shape[-1]
     flat = logits.reshape(-3, 0)
     lab = labels.reshape(-1)
     logp = flat.log_softmax(axis=-1)

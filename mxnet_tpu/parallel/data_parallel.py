@@ -256,7 +256,7 @@ class DataParallelStep:
         def loss_of(params, key, data, label):
             from ..ndarray import NDArray
 
-            out, aux = apply_fn(params, key, data)
+            out, aux = apply_fn(params, key, *data)  # data: tuple of arrays
             out_nd = (NDArray(out, ctx=ctx) if not isinstance(out, list)
                       else [NDArray(o, ctx=ctx) for o in out])
             loss = loss_fn(out_nd, NDArray(label, ctx=ctx))
@@ -288,17 +288,22 @@ class DataParallelStep:
 
     # ------------------------------------------------------------------
     def step(self, data, label):
-        """One fused training step; returns the (host) scalar loss array."""
+        """One fused training step; returns the (host) scalar loss array.
+
+        `data` may be a single NDArray or a tuple/list of NDArrays for
+        multi-input blocks (e.g. the seq2seq Transformer's (src, tgt))."""
         import jax
 
         from .. import random as _random
         from ..ndarray import NDArray
 
-        data_nd = data if isinstance(data, NDArray) else NDArray(data, ctx=self._ctx)
-        self._ensure_state((data_nd,))
+        datas = tuple(data) if isinstance(data, (tuple, list)) else (data,)
+        datas = tuple(d if isinstance(d, NDArray) else NDArray(d, ctx=self._ctx)
+                      for d in datas)
+        self._ensure_state(datas)
         if self._jitted is None:
             self._build()
-        data_arr = data._data if isinstance(data, NDArray) else data
+        data_arrs = tuple(d._data for d in datas)
         label_arr = label._data if isinstance(label, NDArray) else label
         # with an active 'sp' axis, shard the sequence dim (1) over it:
         # true sequence parallelism — GSPMD emits the cross-device
@@ -312,25 +317,36 @@ class DataParallelStep:
             and self.mesh.shape["sp"] > 1
             and "sp" in self._batch_axes
             and self._seq_axis != -1
-            and np.ndim(data_arr) >= 2
+            and any(np.ndim(a) >= 2 for a in data_arrs)
         )
         if sp_active and self._seq_axis is None:
-            sp_active = np.shape(data_arr)[1] % self.mesh.shape["sp"] == 0
-        if sp_active:
-            from .sharding import shard_batch_seq
+            sp_active = all(np.shape(a)[1] % self.mesh.shape["sp"] == 0
+                            for a in data_arrs if np.ndim(a) >= 2)
+        if self._seq_axis == 1 and sp_active:
+            # explicit SP opt-in: a non-divisible seq dim is a caller error,
+            # not something to silently decline (the ring scope and the
+            # shard specs must agree on what was sequence-sharded)
+            bad = [np.shape(a) for a in data_arrs
+                   if np.ndim(a) >= 2
+                   and np.shape(a)[1] % self.mesh.shape["sp"] != 0]
+            if bad:
+                raise MXNetError(
+                    f"seq_axis=1: sequence dims of {bad} are not divisible "
+                    f"by sp={self.mesh.shape['sp']}")
 
-            dsh = shard_batch_seq(self.mesh, np.ndim(data_arr))
-            lsh = (shard_batch_seq(self.mesh, np.ndim(label_arr))
-                   if np.ndim(label_arr) >= 2
-                   else shard_batch(self.mesh, ("dp",),
-                                    np.ndim(label_arr)))
-        else:
-            dsh = shard_batch(self.mesh, self._batch_axes,
-                              np.ndim(data_arr))
-            lsh = shard_batch(self.mesh, self._batch_axes,
-                              np.ndim(label_arr))
-        data_arr = jax.device_put(data_arr, dsh)
-        label_arr = jax.device_put(label_arr, lsh)
+        def _shard_one(arr):
+            if (sp_active and np.ndim(arr) >= 2
+                    and np.shape(arr)[1] % self.mesh.shape["sp"] == 0):
+                from .sharding import shard_batch_seq
+
+                return shard_batch_seq(self.mesh, np.ndim(arr))
+            if sp_active:  # rank-1 (or ragged) input under SP: dp only
+                return shard_batch(self.mesh, ("dp",), np.ndim(arr))
+            return shard_batch(self.mesh, self._batch_axes, np.ndim(arr))
+
+        data_arrs = tuple(jax.device_put(a, _shard_one(a))
+                          for a in data_arrs)
+        label_arr = jax.device_put(label_arr, _shard_one(label_arr))
         key = _random.next_key()
         # Pallas kernels must lower for the platform the MESH runs on (a CPU
         # mesh under a TPU default backend needs interpret mode); the flag is
@@ -364,7 +380,7 @@ class DataParallelStep:
                     f"FusedStep:{type(self.block).__name__}",
                     self._jitted, *a))
             self.params, self.opt_state, loss = run(
-                self.params, self.opt_state, key, data_arr, label_arr)
+                self.params, self.opt_state, key, data_arrs, label_arr)
         self._step_count += 1
         return loss
 
