@@ -253,6 +253,32 @@ class TransformerDecoderCell(HybridBlock):
         x = self.ln2(x + self.drop(self.cross_attn(x, mem, cross_mask)))
         return self.ln3(x + self.ffn(x))
 
+    def step(self, F, x_t, mem, cross_mask_t, K, V, keep, t):
+        """Incremental decode of ONE position with cached self-attn K/V.
+
+        x_t: (B, 1, C); K/V: fixed (B, Lmax, C) caches (this position's
+        k/v are written at row t); keep: (B, Lmax), 1 for rows <= t.
+        Returns (y_t, K, V).  Inference-only (dropout is identity outside
+        autograd.record)."""
+        sa = self.self_attn
+        if self._pre_norm:
+            h = self.ln1(x_t)
+        else:
+            h = x_t
+        qkv = sa.qkv(h)
+        q_t, k_t, v_t = F.split(qkv, num_outputs=3, axis=-1)
+        K[:, t:t + 1] = k_t
+        V[:, t:t + 1] = v_t
+        a = sa.proj(_attend_cached(F, q_t, K, V, keep,
+                                   sa._num_heads, sa._head_dim))
+        if self._pre_norm:
+            x = x_t + a
+            x = x + self.cross_attn(self.ln2(x), mem, cross_mask_t)
+            return x + self.ffn(self.ln3(x)), K, V
+        x = self.ln1(x_t + a)
+        x = self.ln2(x + self.cross_attn(x, mem, cross_mask_t))
+        return self.ln3(x + self.ffn(x)), K, V
+
 
 class TransformerDecoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
@@ -269,6 +295,22 @@ class TransformerDecoder(HybridBlock):
         for cell in self.layers:
             x = cell(x, mem, self_mask, cross_mask)
         return x
+
+
+def _attend_cached(F, q_t, K, V, keep, num_heads, head_dim):
+    """One-query attention over a fixed-size cache.
+
+    q_t: (B, 1, C); K/V: (B, Lmax, C) with valid rows marked by keep
+    (B, Lmax, 1 = attend).  Shape-stable across decode steps (the cache
+    never grows), so XLA compiles the step scorer exactly once."""
+    q = _split_heads(q_t, num_heads, head_dim)        # (B*H, 1, hd)
+    k = _split_heads(K, num_heads, head_dim)          # (B*H, Lmax, hd)
+    v = _split_heads(V, num_heads, head_dim)
+    scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(head_dim)
+    scores = _mask_scores(F, scores, keep.expand_dims(1), num_heads)
+    attn = F.softmax(scores, axis=-1)
+    out = F.batch_dot(attn, v)                        # (B*H, 1, hd)
+    return _merge_heads(out, num_heads)               # (B, 1, C)
 
 
 class Transformer(HybridBlock):
@@ -340,17 +382,38 @@ class Transformer(HybridBlock):
         mem, src_keep = self._encode_h(F, src)
         return self._decode_h(F, tgt, mem, src_keep)
 
+    def _decode_step(self, F, tok_t, t, mem, src_keep, caches, keep):
+        """Logits (B, V) for one decode position using per-layer KV caches
+        (see TransformerDecoderCell.step).  Inference-only."""
+        ctx = tok_t.context
+        x = self.embed(tok_t) * math.sqrt(self._units)  # (B, 1, C)
+        pos_row = F.slice_axis(self.pos.weight.data(ctx), axis=0,
+                               begin=t, end=t + 1)
+        x = F.broadcast_add(x, pos_row.expand_dims(0))
+        cross_mask_t = src_keep.expand_dims(1)  # (B, 1, Ts)
+        for i, cell in enumerate(self.decoder.layers):
+            K, V = caches[i]
+            x, K, V = cell.step(F, x, mem, cross_mask_t, K, V, keep, t)
+            caches[i] = (K, V)
+        if self._tie:
+            return F.FullyConnected(x.reshape(0, -1),
+                                    self.embed.weight.data(ctx),
+                                    num_hidden=self._vocab, no_bias=True)
+        return self.out_proj(x).reshape(0, -1)
+
     # -- inference ---------------------------------------------------------
     def translate(self, src, bos_id, eos_id, max_len=32, beam_size=4,
-                  alpha=0.6):
+                  alpha=0.6, incremental=True):
         """Beam-search decode (GNMT length penalty).
 
         src: NDArray (B, Ts) int.  Returns (B, max_len) numpy int32 of the
         best hypotheses (eos/pad-trimmed by the caller).  The encoder runs
-        ONCE; the per-step scorer is the decoder over a fixed
-        (B*beam, max_len) padded target, so every step reuses one
-        executable; beam bookkeeping is host-side numpy, as in the
-        reference's BeamSearchSampler.
+        ONCE.  With incremental=True (default) the per-step scorer is a
+        single-position decoder over fixed-size per-layer KV caches —
+        O(L) per step, one executable reused every step; incremental=False
+        re-decodes the full padded prefix (O(L^2) per step, the
+        cross-check path).  Beam bookkeeping is host-side numpy, as in
+        the reference's BeamSearchSampler.
         """
         from .. import autograd
         from .. import ndarray as F
@@ -374,15 +437,35 @@ class Transformer(HybridBlock):
         scores[:, 0] = 0.0  # only beam 0 live at t=0 (all beams identical)
         finished = _np.zeros((B, K), bool)
 
+        caches = None
+        if incremental:
+            from ..ndarray import zeros as nd_zeros
+
+            dt = mem.dtype
+            caches = [(nd_zeros((B * K, max_len, self._units), ctx=src.context,
+                                dtype=dt),
+                       nd_zeros((B * K, max_len, self._units), ctx=src.context,
+                                dtype=dt))
+                      for _ in range(len(self.decoder.layers))]
+
         for t in range(1, max_len):
             with autograd.pause():
-                logits = self._decode_h(
-                    F, nd_array(tgt, ctx=src.context, dtype="int32"),
-                    mem, src_keep)
-                # slice the one needed position on-device: the host copy is
-                # (B*K, V), not (B*K, max_len, V)
-                step_logits = F.slice_axis(logits, axis=1, begin=t - 1,
-                                           end=t).reshape(0, -1)
+                if incremental:
+                    keep = _np.zeros((B * K, max_len), _np.float32)
+                    keep[:, :t] = 1.0  # cache rows written so far incl. t-1
+                    step_logits = self._decode_step(
+                        F, nd_array(tgt[:, t - 1:t], ctx=src.context,
+                                    dtype="int32"),
+                        t - 1, mem, src_keep, caches,
+                        nd_array(keep, ctx=src.context))
+                else:
+                    logits = self._decode_h(
+                        F, nd_array(tgt, ctx=src.context, dtype="int32"),
+                        mem, src_keep)
+                    # slice the one needed position on-device: the host
+                    # copy is (B*K, V), not (B*K, max_len, V)
+                    step_logits = F.slice_axis(logits, axis=1, begin=t - 1,
+                                               end=t).reshape(0, -1)
             lp = _np.asarray(step_logits.asnumpy(), _np.float32)  # (B*K, V)
             lp = lp - _np.log(_np.exp(lp - lp.max(-1, keepdims=True)).sum(
                 -1, keepdims=True)) - lp.max(-1, keepdims=True)
@@ -400,6 +483,17 @@ class Transformer(HybridBlock):
                                           beam_idx[:, :, None], axis=1)
             new_tgt[:, :, t] = tok
             tgt = new_tgt.reshape(B * K, max_len)
+            if incremental and not (beam_idx
+                                    == _np.arange(K)[None, :]).all():
+                # the KV caches follow their beams (skipped when the
+                # permutation is identity — always true for beam_size=1)
+                flat = (_np.arange(B)[:, None] * K + beam_idx) \
+                    .reshape(-1).astype(_np.int32)
+                idx_nd = nd_array(flat, ctx=src.context, dtype="int32")
+                with autograd.pause():
+                    caches = [(F.take(Kc, idx_nd, axis=0),
+                               F.take(Vc, idx_nd, axis=0))
+                              for Kc, Vc in caches]
             finished = _np.take_along_axis(finished, beam_idx, axis=1) \
                 | (tok == eos_id) | (tok == self._pad_id)
             if finished.all():

@@ -126,7 +126,7 @@ def test_seq2seq_learns_reverse_and_beam_decodes():
         losses.append(float(loss.asscalar()))
     assert losses[-1] < 0.15, f"no convergence: {losses[::20]}"
 
-    # greedy (beam=1) and beam=3 both reproduce the memorized reversal
+    # beam=3 reproduces the memorized reversal (incremental KV-cache path)
     hyp = net.translate(sb, bos_id=BOS, eos_id=EOS, max_len=tgt_in.shape[1],
                         beam_size=3)
     # hypothesis rows start at position 1 (pos 0 is BOS)
@@ -135,3 +135,12 @@ def test_seq2seq_learns_reverse_and_beam_decodes():
     want = src[:, :L][:, ::-1]
     match = (got == want).mean()
     assert match > 0.9, f"beam decode mismatch {match}: {got[0]} vs {want[0]}"
+
+    # the O(L) cached scorer and the O(L^2) full-prefix scorer agree
+    # (token-agreement, not exact equality: the two reduce in different
+    # float orders, so near-tied beam candidates may legally swap)
+    hyp_full = net.translate(sb, bos_id=BOS, eos_id=EOS,
+                             max_len=tgt_in.shape[1], beam_size=3,
+                             incremental=False)
+    agreement = (hyp == hyp_full).mean()
+    assert agreement > 0.95, f"scorer disagreement {agreement}"
