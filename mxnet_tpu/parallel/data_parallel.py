@@ -115,7 +115,7 @@ class DataParallelStep:
                  batch_axes: Sequence[str] = ("dp", "sp"),
                  seq_axis: Optional[int] = None,
                  donate: bool = True, remat: bool = False,
-                 ring_attention: bool = False):
+                 ring_attention: bool = False, accum_steps: int = 1):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
@@ -132,7 +132,15 @@ class DataParallelStep:
         the model lower to the ring kernel (K/V rotating over ICI via
         ppermute, online softmax) instead of GSPMD's K/V all-gather —
         per-device attention memory stays O((L/sp)^2) for long
-        sequences."""
+        sequences.
+
+        accum_steps: gradient accumulation INSIDE the fused step — the
+        batch is split into accum_steps contiguous microbatches, each
+        forward/backward runs in turn (activation memory is one
+        microbatch's), gradients average, then ONE optimizer update.
+        Statically unrolled in the XLA program; combine with remat=True
+        for maximum effective batch per chip (reference analog:
+        grad_req='add' + delayed Trainer.step)."""
         import jax
 
         from ..context import current_context
@@ -162,6 +170,9 @@ class DataParallelStep:
         self._donate = donate
         self._remat = remat
         self._ring = ring_attention
+        if accum_steps < 1:
+            raise MXNetError(f"accum_steps must be >= 1, got {accum_steps}")
+        self._accum = int(accum_steps)
 
         ctx = current_context()
         self._ctx = ctx
@@ -263,9 +274,37 @@ class DataParallelStep:
             larr = loss._data if isinstance(loss, NDArray) else loss
             return jnp.mean(larr.astype(jnp.float32)), aux
 
+        accum = self._accum
+
         def step(params, opt_state, key, data, label):
-            (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                params, key, data, label)
+            if accum == 1:
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, key, data, label)
+            else:
+                # statically-unrolled microbatch loop.  STRIDED slices
+                # (rows i::accum): each microbatch draws an equal share of
+                # every device's dp shard, so no per-microbatch resharding
+                # collective and no idle devices (a contiguous B/accum
+                # block would live on only dp/accum of the devices)
+                keys = jax.random.split(key, accum)
+                grads, loss, aux_sums = None, 0.0, {}
+                for i in range(accum):
+                    def mb(a, _i=i):
+                        return a[_i::accum]
+                    (l_i, aux), g_i = jax.value_and_grad(
+                        loss_of, has_aux=True)(
+                            params, keys[i], tuple(mb(a) for a in data),
+                            mb(label))
+                    loss = loss + l_i / accum
+                    # aux (BN batch stats) averages over ALL microbatches,
+                    # keeping the "global batch average" contract below
+                    for name, val in aux:
+                        prev = aux_sums.get(name)
+                        aux_sums[name] = val if prev is None else prev + val
+                    grads = (g_i if grads is None else jax.tree_util.tree_map(
+                        lambda a, b: a + b, grads, g_i))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                aux = [(n, v / accum) for n, v in aux_sums.items()]
             if opt == "sgd":
                 new_params, new_state = _sgd_tree_update(
                     params, grads, opt_state, lr, momentum, wd, rescale, mults)
@@ -300,6 +339,14 @@ class DataParallelStep:
         datas = tuple(data) if isinstance(data, (tuple, list)) else (data,)
         datas = tuple(d if isinstance(d, NDArray) else NDArray(d, ctx=self._ctx)
                       for d in datas)
+        if self._accum > 1:
+            label_dim0 = (label.shape[0] if hasattr(label, "shape") else
+                          np.shape(label)[0])
+            for dim0 in [d.shape[0] for d in datas] + [label_dim0]:
+                if dim0 % self._accum:
+                    raise MXNetError(
+                        f"batch {dim0} not divisible by "
+                        f"accum_steps={self._accum}")
         self._ensure_state(datas)
         if self._jitted is None:
             self._build()

@@ -227,6 +227,66 @@ def test_ring_attention_training_step_parity():
     assert "collective-permute" in txt
 
 
+def test_accum_steps_matches_single_pass():
+    """accum_steps=2 (microbatch loop inside the one XLA program) computes
+    the same mean gradient as a single full-batch pass: identical losses
+    step after step (Dense-only net — BN batch stats would legitimately
+    differ per microbatch)."""
+    import jax
+
+    devices = jax.devices("cpu")[:2]
+
+    def run(accum):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        step = DataParallelStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                mesh=local_mesh(devices=devices),
+                                optimizer="sgd", accum_steps=accum,
+                                optimizer_params={"learning_rate": 0.1,
+                                                  "momentum": 0.9})
+        rng = np.random.RandomState(5)
+        x = nd.array(rng.rand(8, 10).astype(np.float32))
+        y = nd.array(rng.randint(0, 4, 8).astype(np.float32))
+        return [float(np.asarray(step.step(x, y))) for _ in range(4)]
+
+    np.testing.assert_allclose(run(1), run(2), rtol=1e-5)
+
+    # BN aux state flows through the accumulated step (averaged over
+    # microbatches) and training still descends
+    mx.random.seed(0)
+    net_bn = nn.HybridSequential()
+    with net_bn.name_scope():
+        net_bn.add(nn.Dense(16), nn.BatchNorm(), nn.Activation("relu"),
+                   nn.Dense(4))
+    net_bn.initialize(mx.init.Xavier())
+    stepb = DataParallelStep(net_bn, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=local_mesh(devices=devices),
+                             optimizer="sgd", accum_steps=2,
+                             optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(6)
+    xb = nd.array(rng.rand(8, 10).astype(np.float32))
+    yb = nd.array(rng.randint(0, 4, 8).astype(np.float32))
+    lb = [float(np.asarray(stepb.step(xb, yb))) for _ in range(6)]
+    assert all(np.isfinite(lb)) and lb[-1] < lb[0]
+    stepb.sync_to_block()
+    rm = net_bn.collect_params()[
+        [k for k in net_bn.collect_params() if "running_mean" in k][0]]
+    assert float(np.abs(rm.data().asnumpy()).sum()) > 0  # stats moved
+
+    # indivisible batch is a caller error
+    net = nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    bad = DataParallelStep(net, gluon.loss.L2Loss(),
+                           mesh=local_mesh(devices=devices),
+                           optimizer="sgd", accum_steps=3)
+    with pytest.raises(mx.MXNetError):
+        bad.step(nd.array(np.random.rand(8, 4).astype(np.float32)),
+                 nd.array(np.random.rand(8, 2).astype(np.float32)))
+
+
 def test_remat_step_matches_plain():
     """remat=True (jax.checkpoint over the forward) must change memory, not
     math: same loss as the plain fused step."""
