@@ -1,0 +1,102 @@
+"""Preemption-recovery worker (SURVEY §5.3: first-class checkpoint/restart
+for pod preemption; reference posture is epoch-level save_checkpoint with
+no mid-run recovery).
+
+Phase 0 (MX_RESUME_PHASE=0): uninterrupted 120-step run; rank 0 writes its
+final weights as the baseline.
+
+Phase 1 (MX_RESUME_PHASE=1): same training with step-granular
+AsyncCheckpointer; the process deliberately dies ("preemption") after 30
+steps, past the step-20 checkpoint.
+
+Phase 2 (MX_RESUME_PHASE=2): a FRESH set of processes restores the
+checkpoint (params + trainer momentum + RNG), verifies it resumed at step
+20, finishes training, checks cross-worker consistency AND that the final
+weights match the uninterrupted baseline — preemption is
+trajectory-invisible.
+
+Run via tools/launch.py local mode (the test drives all phases).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd
+
+
+def build():
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Normal(0.5))
+    return net
+
+
+def main():
+    phase = int(os.environ["MX_RESUME_PHASE"])
+    base = os.environ["MX_RESUME_DIR"]
+    sub = "baseline" if phase == 0 else "resume"
+    ckdir = os.path.join(base, sub,
+                         f"rank{os.environ.get('MX_PROC_ID', '0')}")
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = X @ np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    lo, hi = rank * (32 // n), (rank + 1) * (32 // n)
+
+    net = build()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=kv)
+    loss_fn = gluon.loss.L2Loss()
+    start = checkpoint.restore(ckdir, net, trainer)
+    if phase == 2:
+        assert start == 20, f"expected resume at step 20, got {start}"
+    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=20, keep=2)
+
+    total_steps = 120
+    for step_i in range(start, total_steps):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X[lo:hi])), nd.array(Y[lo:hi]))
+        loss.backward()
+        trainer.step(hi - lo)
+        ckpt.step(net, trainer=trainer)
+        if phase == 1 and step_i == 29:
+            ckpt.wait()
+            kv.barrier()  # both ranks checkpointed before the "preemption"
+            print(f"worker {rank}: preempting at step {step_i + 1}",
+                  flush=True)
+            os._exit(43)
+    ckpt.close()
+
+    final = float(loss.mean().asnumpy())
+    assert final < 0.01, f"worker {rank}: loss {final} after resume"
+    w = net.weight.data()
+    summed = kv._global_sum(w)
+    np.testing.assert_allclose(summed.asnumpy(), w.asnumpy() * n, rtol=1e-5,
+                               err_msg="weights diverged across workers")
+    baseline_path = os.path.join(base, "final_weights.npy")
+    if phase == 0:
+        if rank == 0:
+            np.save(baseline_path, w.asnumpy())
+        kv.barrier()
+        print(f"worker {rank}/{n}: baseline train OK loss={final:.5f}",
+              flush=True)
+        return
+    # preemption must be trajectory-invisible: momentum + RNG restored,
+    # so the resumed run lands on the SAME weights
+    np.testing.assert_allclose(w.asnumpy(), np.load(baseline_path),
+                               rtol=1e-6, atol=1e-7,
+                               err_msg="resumed weights diverge from the "
+                                       "uninterrupted run")
+    kv.barrier()
+    print(f"worker {rank}/{n}: resume train OK loss={final:.5f} "
+          "matches uninterrupted baseline", flush=True)
+
+
+if __name__ == "__main__":
+    main()

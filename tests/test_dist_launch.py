@@ -15,12 +15,12 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=240):
+def _launch(n, script, timeout=240, env=None):
     cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
            "-n", str(n), "--force-cpu", "--",
            sys.executable, os.path.join(_REPO, script)]
     return subprocess.run(cmd, cwd=_REPO, timeout=timeout,
-                          capture_output=True, text=True)
+                          capture_output=True, text=True, env=env)
 
 
 def test_dist_sync_kvstore_two_workers():
@@ -48,6 +48,32 @@ def test_dist_sync_training_three_workers():
     res = _launch(3, "tests/dist/dist_train_worker.py", timeout=300)
     assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
     assert res.stdout.count("dist train OK") == 3, res.stdout
+
+
+def test_dist_preemption_checkpoint_resume(tmp_path):
+    """Kill a 2-worker sync job mid-run ("preemption"), relaunch fresh
+    processes, resume from the step-granular checkpoint (params + trainer
+    momentum + RNG), and finish with the SAME final weights as an
+    uninterrupted run — preemption must be trajectory-invisible (SURVEY
+    §5.3's TPU-native recovery posture; the reference stalls forever)."""
+    worker = "tests/dist/dist_resume_worker.py"
+    env = dict(os.environ, MX_RESUME_DIR=str(tmp_path))
+
+    # uninterrupted baseline (its own checkpoint dir)
+    env["MX_RESUME_PHASE"] = "0"
+    res0 = _launch(2, worker, env=dict(env))
+    assert res0.returncode == 0, (res0.stdout[-1500:], res0.stderr[-800:])
+
+    env["MX_RESUME_PHASE"] = "1"
+    res1 = _launch(2, worker, env=dict(env))
+    assert res1.returncode == 43, (res1.stdout[-1500:], res1.stderr[-800:])
+    assert res1.stdout.count("preempting at step 30") >= 1, res1.stdout
+
+    env["MX_RESUME_PHASE"] = "2"
+    res2 = _launch(2, worker, env=dict(env))
+    assert res2.returncode == 0, (res2.stdout[-1500:], res2.stderr[-800:])
+    assert res2.stdout.count("resume train OK") == 2, res2.stdout
+    assert "matches uninterrupted baseline" in res2.stdout, res2.stdout
 
 
 def test_launch_detects_nonrank0_crash(tmp_path):
