@@ -42,6 +42,22 @@ def test_train_imagenet_rec_e2e(tmp_path):
     assert "final loss" in res.stdout, res.stdout[-500:]
 
 
+def test_train_wmt_e2e(tmp_path):
+    """Seq2seq example through the fused multi-input step, incl. the
+    file-backed corpus path."""
+    src_f, tgt_f = tmp_path / "s.txt", tmp_path / "t.txt"
+    src_f.write_text("4 5 6 7\n8 9 10\n")
+    tgt_f.write_text("7 6 5 4\n10 9 8\n")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "train_wmt.py"),
+         "--device", "cpu", "--model", "tiny", "--vocab-size", "16",
+         "--batch-size", "2", "--steps", "3",
+         "--src", str(src_f), "--tgt", str(tgt_f)],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "final loss" in res.stdout, res.stdout[-500:]
+
+
 def test_train_mnist_e2e():
     res = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", "train_mnist.py"),
