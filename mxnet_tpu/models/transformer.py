@@ -93,11 +93,15 @@ class MultiHeadAttention(HybridBlock):
         scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
         if self._causal:
             T = scores.shape[-1]
-            tril = F.array(np.tril(np.ones((T, T), np.float32)),
-                           ctx=scores.context)
             neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
-            scores = F.broadcast_add(
-                scores, (1.0 - tril).expand_dims(0) * neg)
+            # constant built host-side IN the score dtype: an f32 addend
+            # would silently promote the whole bf16 attention chain to f32
+            from ..base import dtype_np
+
+            addend = F.array(
+                np.triu(np.full((T, T), neg, dtype_np(scores.dtype)), k=1),
+                ctx=scores.context, dtype=dtype_np(scores.dtype))
+            scores = F.broadcast_add(scores, addend.expand_dims(0))
         if mask is not None:
             scores = _mask_scores(F, scores, mask, self._num_heads)
         attn = F.softmax(scores, axis=-1)

@@ -54,6 +54,29 @@ def test_forward_shapes_and_padding_invariance():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_bf16_cast_stays_bf16_and_roundtrips():
+    """cast('bfloat16') must keep the whole forward in bf16 (an f32
+    causal-mask constant used to promote the decoder attention chain),
+    and save/load round-trips the tied/positional weights."""
+    import tempfile
+
+    net = _tiny_model()
+    rng = np.random.RandomState(4)
+    src = nd.array(rng.randint(3, 16, (2, 7)).astype(np.int32), dtype="int32")
+    tgt = nd.array(rng.randint(3, 16, (2, 8)).astype(np.int32), dtype="int32")
+    out32 = net(src, tgt).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        p = f"{d}/t.params"
+        net.save_parameters(p)
+        net2 = _tiny_model()
+        net2.load_parameters(p)
+        np.testing.assert_allclose(out32, net2(src, tgt).asnumpy(), rtol=1e-6)
+    net.cast("bfloat16")
+    outb = net(src, tgt)
+    assert "bfloat16" in str(outb.dtype), outb.dtype
+    assert np.isfinite(outb.asnumpy().astype(np.float32)).all()
+
+
 def test_label_smoothed_ce_reduces_to_ce():
     rng = np.random.RandomState(1)
     logits = nd.array(rng.randn(3, 5, 7).astype(np.float32))
