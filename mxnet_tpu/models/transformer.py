@@ -16,7 +16,7 @@ import math
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, dtype_np
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
@@ -96,8 +96,6 @@ class MultiHeadAttention(HybridBlock):
             neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
             # constant built host-side IN the score dtype: an f32 addend
             # would silently promote the whole bf16 attention chain to f32
-            from ..base import dtype_np
-
             addend = F.array(
                 np.triu(np.full((T, T), neg, dtype_np(scores.dtype)), k=1),
                 ctx=scores.context, dtype=dtype_np(scores.dtype))

@@ -194,8 +194,92 @@ def _make_stub(op):
     return stub
 
 
+# ---------------------------------------------------------------------------
+# public ufunc wrappers (reference: ndarray.py _ufunc_helper — nd.add /
+# nd.power / nd.equal ... dispatch on array-vs-scalar operands).  Scalar
+# operands become STATIC attrs of the *_scalar ops (the reference's
+# fn_scalar path), never array inputs: that keeps float-vs-int-array
+# comparisons exact (1.5 is not truncated to the array dtype) and keeps
+# power's exponent out of the gradient (see NDArray.__pow__).
+# name -> (broadcast_op, np_fn, scalar_op, reversed_scalar_op)
+# ---------------------------------------------------------------------------
+_UFUNCS = {
+    "add": ("broadcast_add", np.add, "_plus_scalar", "_plus_scalar"),
+    "subtract": ("broadcast_sub", np.subtract, "_minus_scalar",
+                 "_rminus_scalar"),
+    "multiply": ("broadcast_mul", np.multiply, "_mul_scalar", "_mul_scalar"),
+    "divide": ("broadcast_div", np.divide, "_div_scalar", "_rdiv_scalar"),
+    "true_divide": ("broadcast_div", np.divide, "_div_scalar",
+                    "_rdiv_scalar"),
+    "mod": ("broadcast_mod", np.mod, "_mod_scalar", "_rmod_scalar"),
+    "equal": ("broadcast_equal", np.equal, "_equal_scalar", "_equal_scalar"),
+    "not_equal": ("broadcast_not_equal", np.not_equal, "_not_equal_scalar",
+                  "_not_equal_scalar"),
+    "greater": ("broadcast_greater", np.greater, "_greater_scalar",
+                "_lesser_scalar"),
+    "greater_equal": ("broadcast_greater_equal", np.greater_equal,
+                      "_greater_equal_scalar", "_lesser_equal_scalar"),
+    "lesser": ("broadcast_lesser", np.less, "_lesser_scalar",
+               "_greater_scalar"),
+    "lesser_equal": ("broadcast_lesser_equal", np.less_equal,
+                     "_lesser_equal_scalar", "_greater_equal_scalar"),
+    "logical_and": ("broadcast_logical_and", np.logical_and,
+                    "_logical_and_scalar", "_logical_and_scalar"),
+    "logical_or": ("broadcast_logical_or", np.logical_or,
+                   "_logical_or_scalar", "_logical_or_scalar"),
+    "logical_xor": ("broadcast_logical_xor", np.logical_xor,
+                    "_logical_xor_scalar", "_logical_xor_scalar"),
+}
+
+
+def _make_ufunc(name, broadcast_op, np_fn, scalar_op, rscalar_op):
+    def f(lhs, rhs):
+        lnd, rnd = isinstance(lhs, NDArray), isinstance(rhs, NDArray)
+        if lnd and rnd:
+            return _reg.invoke_by_name(broadcast_op, [lhs, rhs])
+        if lnd:
+            return _reg.invoke_by_name(scalar_op, [lhs], scalar=float(rhs))
+        if rnd:
+            return _reg.invoke_by_name(rscalar_op, [rhs], scalar=float(lhs))
+        # both python scalars: plain number out (reference behavior)
+        return np_fn(lhs, rhs)
+
+    f.__name__ = name
+    f.__doc__ = (f"Element-wise {name} with scalar/array dispatch "
+                 f"(maps to {broadcast_op} / {scalar_op}).")
+    return f
+
+
+def power(lhs, rhs):
+    """Element-wise power; scalar exponents stay static attrs so no
+    d/d(exponent) gradient path appears (see NDArray.__pow__)."""
+    if isinstance(lhs, NDArray):
+        return lhs.__pow__(rhs)
+    if isinstance(rhs, NDArray):
+        return rhs.__rpow__(lhs)
+    return np.power(lhs, rhs)
+
+
+def hypot(lhs, rhs):
+    """Element-wise hypot with scalar/array dispatch."""
+    import jax.numpy as jnp
+
+    lnd, rnd = isinstance(lhs, NDArray), isinstance(rhs, NDArray)
+    if lnd and rnd:
+        return _reg.invoke_by_name("broadcast_hypot", [lhs, rhs])
+    if lnd:
+        return _reg.invoke_fn(lambda x: jnp.hypot(x, float(rhs)), [lhs])
+    if rnd:
+        return _reg.invoke_fn(lambda x: jnp.hypot(float(lhs), x), [rhs])
+    return np.hypot(lhs, rhs)
+
+
 def _populate():
     g = globals()
+    for _name, (_bop, _np_fn, _sop, _rsop) in _UFUNCS.items():
+        g[_name] = _make_ufunc(_name, _bop, _np_fn, _sop, _rsop)
+        __all__.append(_name)
+    __all__.extend(["power", "hypot"])
     for name in _reg.list_ops():
         if name in _SPECIAL:
             g[name] = _SPECIAL[name]

@@ -176,6 +176,31 @@ def test_legacy_index_ops():
     mhs = nd.array(np.array([-1, -2, -3, -4], np.float32))
     filled = nd.fill_element_0index(lhs, mhs, rhs).asnumpy()
     assert filled[0, 0] == -1 and filled[1, 2] == -2
+    # public ufunc wrappers dispatch array/array, array/scalar,
+    # scalar/array, scalar/scalar (reference _ufunc_helper)
+    a = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    b = nd.array(np.array([3.0, 2.0, 1.0], np.float32))
+    np.testing.assert_allclose(nd.power(a, b).asnumpy(), [1, 4, 3])
+    np.testing.assert_allclose(nd.power(a, 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose(nd.power(2, a).asnumpy(), [2, 4, 8])
+    assert nd.add(1, 1) == 2.0
+    np.testing.assert_allclose(nd.equal(a, b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose(nd.greater_equal(a, 2).asnumpy(), [0, 1, 1])
+    np.testing.assert_allclose(nd.lesser_equal(a, b).asnumpy(), [1, 1, 0])
+    np.testing.assert_allclose(nd.hypot(a, b).asnumpy(),
+                               np.hypot([1, 2, 3], [3, 2, 1]), rtol=1e-6)
+    np.testing.assert_allclose(nd.mod(b, 2).asnumpy(), [1, 0, 1])
+    np.testing.assert_allclose(nd.logical_xor(a - 1, b).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose(nd.true_divide(a, b).asnumpy(),
+                               [1 / 3, 1.0, 3.0], rtol=1e-6)
+    # scalars are STATIC attrs, not inputs cast to the array dtype:
+    # float-vs-int comparisons stay exact, fractional exponents promote
+    ia = nd.array(np.array([1, 2], np.int32), dtype="int32")
+    np.testing.assert_allclose(nd.equal(ia, 1.5).asnumpy(), [0, 0])
+    np.testing.assert_allclose(nd.power(ia, 2.5).asnumpy(),
+                               [1.0, 2 ** 2.5], rtol=1e-6)
+    assert nd.add(1, 1) == 2 and not isinstance(nd.add(1, 1), float)
+
     # pick accepts the axis dim removed OR kept as size 1 (reference
     # PickOpShape) — gluon SoftmaxCE feeds (B,1) ImageRecordIter labels
     x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
