@@ -104,6 +104,26 @@ def _make_sym_stub(op):
 _SKIP_PREFIXES = ("_random_", "_sample_", "sample_")
 
 
+def _make_sym_ufunc(name, bop, np_fn, sop, rsop):
+    """Symbol-side ufunc with scalar dispatch (reference: symbol.py
+    _ufunc_helper — same table as the nd namespace)."""
+
+    def f(lhs, rhs, **kw):
+        g = globals()
+        l, r = isinstance(lhs, Symbol), isinstance(rhs, Symbol)
+        if l and r:
+            return g[bop](lhs, rhs, **kw)
+        if l:
+            return g[sop](lhs, scalar=float(rhs), **kw)
+        if r:
+            return g[rsop](rhs, scalar=float(lhs), **kw)
+        return np_fn(lhs, rhs)
+
+    f.__name__ = name
+    f.__doc__ = f"Element-wise {name} (maps to {bop} / {sop})."
+    return f
+
+
 def _populate():
     g = globals()
     for opname in _reg.list_ops():
@@ -115,6 +135,17 @@ def _populate():
     g["concat"] = g["Concat"]
     g["flatten"] = g["Flatten"]
     g["cast"] = g["Cast"]
+    from ..ndarray import _UFUNCS
+
+    for _name, (_bop, _np_fn, _sop, _rsop) in _UFUNCS.items():
+        g[_name] = _make_sym_ufunc(_name, _bop, _np_fn, _sop, _rsop)
+        __all__.append(_name)
+
+    import numpy as _np
+
+    g["power"] = _make_sym_ufunc("power", "broadcast_power", _np.power,
+                                 "_power_scalar", "_rpower_scalar")
+    __all__.append("power")
 
 
 _populate()

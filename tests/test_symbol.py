@@ -200,3 +200,23 @@ def test_attr_scope_reuse_and_op_nodes():
     # op nodes carry the scope attrs for introspection
     node = s._entries[0][0]
     assert node.vattrs.get("attr", {}).get("group") == "g"
+
+
+def test_sym_ufunc_scalar_dispatch():
+    """Symbol-side ufunc family (reference symbol.py _ufunc_helper):
+    array/array -> broadcast op, array/scalar -> *_scalar op node, and the
+    graph serializes through tojson."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    vals = {"a": mx.nd.array(np.array([1., 2., 3.], np.float32)),
+            "b": mx.nd.array(np.array([3., 2., 1.], np.float32))}
+    for expr, expect in [(mx.sym.power(a, b), [1, 4, 3]),
+                         (mx.sym.power(a, 2), [1, 4, 9]),
+                         (mx.sym.equal(a, 2.0), [0, 1, 0]),
+                         (mx.sym.greater_equal(2, a), [1, 1, 0]),
+                         (mx.sym.logical_and(a - 1, b), [0, 1, 1]),
+                         (mx.sym.mod(b, 2), [1, 0, 1])]:
+        args = {k: vals[k] for k in expr.list_arguments()}
+        out = expr.bind(mx.cpu(), args).forward()[0].asnumpy()
+        np.testing.assert_allclose(out, expect)
+    assert mx.sym.load_json(mx.sym.power(a, 2).tojson()) is not None
