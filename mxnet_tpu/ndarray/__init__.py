@@ -20,6 +20,7 @@ from .ndarray import NDArray, array, from_jax
 from . import random  # noqa: F401  (nd.random namespace)
 from .utils import save, load, save_legacy
 from . import contrib  # noqa: F401  (nd.contrib namespace)
+from . import linalg  # noqa: F401  (nd.linalg namespace)
 from . import sparse  # noqa: F401  (nd.sparse namespace)
 from .sparse import RowSparseNDArray, CSRNDArray
 from ..operator import Custom  # noqa: F401  (mx.nd.Custom)
@@ -289,6 +290,12 @@ def _populate():
         op = _reg.get_op(name)
         g[name] = _make_stub(op)
         __all__.append(name)
+    # nd.linalg.* short spellings alias the SAME stubs as the flat names
+    for _opname in _reg.list_ops():
+        if _opname.startswith("linalg_"):
+            _short = _opname[len("linalg_"):]
+            setattr(linalg, _short, g[_opname])
+            linalg.__all__.append(_short)
     # common aliases
     g["concatenate"] = g["Concat"]
     g["concat"] = g["Concat"]

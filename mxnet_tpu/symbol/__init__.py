@@ -147,6 +147,20 @@ def _populate():
                                  "_power_scalar", "_rpower_scalar")
     __all__.append("power")
 
+    # sym.linalg namespace: short spellings over the linalg_* stubs
+    # (reference: python/mxnet/symbol/linalg.py).  Registered in
+    # sys.modules so `import mxnet_tpu.symbol.linalg` works too.
+    import sys
+    import types
+
+    lin = types.ModuleType(__name__ + ".linalg")
+    lin.__doc__ = "The mx.sym.linalg namespace (linalg_* op spellings)."
+    for opname in _reg.list_ops():
+        if opname.startswith("linalg_"):
+            setattr(lin, opname[len("linalg_"):], g[opname])
+    g["linalg"] = lin
+    sys.modules[lin.__name__] = lin
+
 
 _populate()
 

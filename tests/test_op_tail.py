@@ -346,3 +346,24 @@ def test_param_validation_inside_hybridized_block():
     net.hybridize()
     with pytest.raises(mx.base.MXNetError):
         net(nd.zeros((1, 1, 4, 4)))
+
+
+def test_linalg_namespaces():
+    """nd.linalg.* / sym.linalg.* spellings (reference:
+    python/mxnet/{ndarray,symbol}/linalg.py) match the flat linalg_* ops."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    A = nd.array(rng.rand(3, 3).astype(np.float32))
+    B = nd.array(rng.rand(3, 3).astype(np.float32))
+    np.testing.assert_allclose(nd.linalg.gemm2(A, B).asnumpy(),
+                               A.asnumpy() @ B.asnumpy(), rtol=1e-5)
+    spd = nd.array((np.eye(3) * 4).astype(np.float32))
+    np.testing.assert_allclose(nd.linalg.potrf(spd).asnumpy(),
+                               np.eye(3, dtype=np.float32) * 2, atol=1e-6)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.linalg.gemm2(a, b).bind(
+        mx.cpu(), {"a": A, "b": B}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), A.asnumpy() @ B.asnumpy(),
+                               rtol=1e-5)
