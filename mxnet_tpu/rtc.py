@@ -1,0 +1,30 @@
+"""``mx.rtc`` — runtime kernel compilation (DESCOPED on TPU).
+
+Reference: src/common/rtc.cc (`mx.rtc.CudaModule` compiles CUDA source via
+NVRTC / hipRTC at runtime).  There is no CUDA-source path on TPU and XLA
+is already a runtime compiler; the sanctioned runtime-kernel mechanism in
+this framework is Pallas (``mxnet_tpu.ops.pallas`` — see
+ops/pallas/flash_attention.py for the pattern).  Every entry point here
+raises with that pointer rather than silently not existing.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc is descoped on TPU: there is no CUDA-source runtime "
+        "compilation path.  Write runtime kernels in Pallas instead "
+        "(mxnet_tpu.ops.pallas; ops/pallas/flash_attention.py is the "
+        "worked example), or rely on XLA fusion which compiles the "
+        "traced graph at runtime already.")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

@@ -280,3 +280,14 @@ def test_gluon_ctc_loss_trains():
         last = v
     assert np.isfinite(last)
     assert last < first, (first, last)
+
+
+def test_rtc_raises_with_pallas_pointer():
+    """mx.rtc exists and raises the documented descope error (reference
+    src/common/rtc.cc; the TPU runtime-kernel path is Pallas)."""
+    import mxnet_tpu as mx
+
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k(){}")
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaKernel()
