@@ -134,12 +134,16 @@ def main():
         result = _run_child("cpu", RUN_TIMEOUT_CPU, history)
     if result is None:  # even CPU failed: still emit one parseable line
         model = os.environ.get("BENCH_MODEL", "resnet")
+        metric, unit = {
+            "bert": ("bert_base_mlm_tokens_per_sec_per_chip", "tokens/sec"),
+            "transformer": ("transformer_base_train_tokens_per_sec_per_chip",
+                            "tokens/sec"),
+        }.get(model, ("resnet50_v1b_train_images_per_sec_per_chip",
+                      "images/sec"))
         result = {
-            "metric": ("bert_base_mlm_tokens_per_sec_per_chip"
-                       if model == "bert" else
-                       "resnet50_v1b_train_images_per_sec_per_chip"),
+            "metric": metric,
             "value": 0.0,
-            "unit": "tokens/sec" if model == "bert" else "images/sec",
+            "unit": unit,
             "vs_baseline": 0.0,
             "error": "all bench subprocesses failed",
             "probe_history": history,
@@ -151,6 +155,28 @@ def main():
 
 # ---------------------------------------------------------------------------
 # measurement children
+
+
+def _timed_steps(run_step, steps, trials=3):
+    """Warmup (compile) + best-of-`trials` timing of `steps` iterations.
+
+    run_step() must RETURN the step's loss; the loss is materialized on
+    the host after each trial because jax.block_until_ready does NOT
+    block through the axon relay — each step's loss depends on the
+    previous step's params, so the host read times every dispatched
+    step.  Returns best seconds per trial."""
+    import numpy as np
+
+    loss = run_step()
+    float(np.asarray(loss))
+    best_dt = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = run_step()
+        float(np.asarray(loss))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    return best_dt
 
 
 def _common_setup(platform):
@@ -199,19 +225,7 @@ def bench_bert(platform):
     labels = tokens.astype(np.float32)
     tb = nd.array(tokens, ctx=ctx, dtype="int32")
     lb = nd.array(labels, ctx=ctx)
-    # warmup (compile).  NB: block_until_ready does not actually block
-    # through the axon relay — materialize the loss on the host to force
-    # the full step chain (each step's loss depends on the previous
-    # step's params, so this times every dispatched step).
-    loss = step.step(tb, lb)
-    float(np.asarray(loss))
-    best_dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = step.step(tb, lb)
-        float(np.asarray(loss))
-        best_dt = min(best_dt, time.perf_counter() - t0)
+    best_dt = _timed_steps(lambda: step.step(tb, lb), steps)
     tok_per_sec = batch * seqlen * steps / best_dt
     baseline = 3000.0  # GluonNLP BERT-base fp16 V100 (BASELINE.md)
     print(json.dumps({
@@ -219,6 +233,57 @@ def bench_bert(platform):
         "value": round(tok_per_sec, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_sec / baseline, 4),
+        "platform": platform,
+        "batch": batch, "seqlen": seqlen,
+    }))
+
+
+def bench_transformer(platform):
+    """Config-4 measurement: Transformer (base by default, BENCH_SIZE=big)
+    seq2seq training tokens/sec/chip, label-smoothed CE, fused multi-input
+    step.  No published per-GPU reference number survives for the exact
+    recipe (BASELINE.json.published is empty), so vs_baseline is reported
+    as 0.0 and the raw number is the record."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.transformer import (label_smoothed_ce,
+                                              transformer_base,
+                                              transformer_big)
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", 16 if on_tpu else 2))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 128 if on_tpu else 16))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    vocab = int(os.environ.get("BENCH_VOCAB", 32000 if on_tpu else 128))
+    big = os.environ.get("BENCH_SIZE", "base") == "big"
+
+    net = (transformer_big if big else transformer_base)(vocab)
+    net.initialize(mx.init.Xavier())
+    if on_tpu:
+        net.cast("bfloat16")
+    step = DataParallelStep(
+        net, lambda lo, la: label_smoothed_ce(lo, la, smoothing=0.1),
+        mesh=local_mesh(devices=[ctx.jax_device]), optimizer="adam",
+        optimizer_params={"learning_rate": 1e-4})
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, vocab, (batch, seqlen)).astype(np.int32)
+    tgt_in = np.concatenate(
+        [np.ones((batch, 1), np.int32), src[:, ::-1]], axis=1)
+    tgt_out = np.concatenate(
+        [src[:, ::-1], np.full((batch, 1), 2, np.int32)], axis=1)
+    sb = nd.array(src, ctx=ctx, dtype="int32")
+    tb = nd.array(tgt_in, ctx=ctx, dtype="int32")
+    lb = nd.array(tgt_out.astype(np.float32), ctx=ctx)
+    best_dt = _timed_steps(lambda: step.step((sb, tb), lb), steps)
+    tok_per_sec = batch * (seqlen + 1) * steps / best_dt
+    print(json.dumps({
+        "metric": f"transformer_{'big' if big else 'base'}_train_tokens"
+                  "_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
         "platform": platform,
         "batch": batch, "seqlen": seqlen,
     }))
@@ -258,18 +323,7 @@ def bench_resnet(platform):
         x = x.astype(ml_dtypes.bfloat16)
     xb, yb = nd.array(x, ctx=ctx, dtype=x.dtype), nd.array(y, ctx=ctx)
 
-    # warmup (compile); host-materialized sync — see bench_bert note.
-    loss = step.step(xb, yb)
-    float(np.asarray(loss))
-
-    best_dt = float("inf")
-    for _trial in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = step.step(xb, yb)
-        float(np.asarray(loss))
-        best_dt = min(best_dt, time.perf_counter() - t0)
-
+    best_dt = _timed_steps(lambda: step.step(xb, yb), steps)
     img_per_sec = batch * steps / best_dt
     baseline = 1450.0  # MXNet-CUDA V100 fp16 (BASELINE.md)
     print(json.dumps({
@@ -283,8 +337,11 @@ def bench_resnet(platform):
 
 
 def child_main(platform):
-    if os.environ.get("BENCH_MODEL", "resnet") == "bert":
+    model = os.environ.get("BENCH_MODEL", "resnet")
+    if model == "bert":
         bench_bert(platform)
+    elif model == "transformer":
+        bench_transformer(platform)
     else:
         bench_resnet(platform)
 

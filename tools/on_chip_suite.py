@@ -85,6 +85,8 @@ def main():
         run("bench_bert_nofusion", [py, "bench.py"],
             {"BENCH_MODEL": "bert", "MXNET_USE_FUSION": "0"},
             timeout=t, log=log)
+    run("bench_transformer_base", [py, "bench.py"],
+        {"BENCH_MODEL": "transformer"}, timeout=t, log=log)
     run("bench_step_eager_vs_fused",
         [py, "tools/bench_step.py", "--device", "tpu", "--batch", "64",
          "--res", "64", "--steps", "5"], timeout=t, log=log)
