@@ -10,6 +10,7 @@ from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
                    global_allreduce)
 from .data_parallel import DataParallelStep, make_train_step
 from .ring import ring_attention, ring_self_attention
+from .ulysses import ulysses_self_attention
 from .pipeline import pipeline_apply
 from .scope import ring_attention_scope, ring_scope, ring_scope_mesh
 from . import dist

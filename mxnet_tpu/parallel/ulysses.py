@@ -1,0 +1,85 @@
+"""Ulysses-style all-to-all sequence parallelism (exact attention).
+
+The second long-context mechanism SURVEY §5.7 calls for, complementing
+the ring (parallel/ring.py): instead of rotating K/V blocks around the
+'sp' axis, ONE all-to-all redistributes the sequence-sharded q/k/v so
+each device holds ALL tokens for 1/sp of the heads, attention runs
+locally (any kernel — here the dense composition XLA fuses; Pallas
+flash drops in), and a second all-to-all restores sequence sharding.
+
+Trade-off vs the ring: 2 all-to-alls of activation size per tensor
+(constant collective count, bandwidth-bound, great on ICI's all-to-all)
+vs sp-1 ppermute steps overlappable with compute; Ulysses caps sp at
+the head count, the ring does not.  Differentiable via the built-in
+all_to_all transpose rule.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["ulysses_self_attention"]
+
+
+def _dense_attn(q, k, v, causal, sm_scale):
+    s = jnp.einsum("nqd,nkd->nqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((qpos >= kpos)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("nqk,nkd->nqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ulysses_self_attention(mesh, q, k, v, causal: bool = False,
+                           sm_scale: Optional[float] = None,
+                           axis: str = "sp",
+                           batch_axes: Optional[tuple] = None):
+    """Exact self-attention over q/k/v (N, L, D) with L sharded on `axis`.
+
+    N (= batch*heads) must be divisible by the axis size: the all-to-all
+    trades the sequence shard for a head shard.  Returns (N, L, D) with
+    the input sharding.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shape = dict(mesh.shape)
+    if axis not in shape:
+        raise MXNetError(f"mesh has no {axis!r} axis: {tuple(shape)}")
+    S = shape[axis]
+    # the all_to_all splits the PER-SHARD leading dim: account for any
+    # batch_axes sharding of N before checking divisibility
+    n_batch = 1
+    for a in (batch_axes or ()):
+        n_batch *= shape.get(a, 1)
+    if q.shape[0] % max(n_batch, 1) or (q.shape[0] // max(n_batch, 1)) % S:
+        raise MXNetError(
+            f"Ulysses SP: local N={q.shape[0]}/{n_batch} heads*batch not "
+            f"divisible by {axis}={S} (the all-to-all shards heads)")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def fn(q_l, k_l, v_l):
+        # (N, L/S, D) -> all-to-all -> (N/S, L, D): all tokens, 1/S heads
+        def seq2head(x):
+            return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=1,
+                                      tiled=True)
+
+        def head2seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
+                                      tiled=True)
+
+        qh, kh, vh = seq2head(q_l), seq2head(k_l), seq2head(v_l)
+        out = _dense_attn(qh, kh, vh, causal, sm_scale)
+        return head2seq(out)
+
+    spec = P(tuple(batch_axes) if batch_axes else None, axis, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=spec, check_vma=False)(q, k, v)
