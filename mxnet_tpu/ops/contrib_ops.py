@@ -43,25 +43,30 @@ def flash_attention_op(q, k, v, causal=False, sm_scale=None):
 
     scope = ring_scope()
     if scope is not None and q.ndim == 3:
-        mesh, batch_axes = scope
+        mesh, batch_axes, mode = scope
         shape = dict(mesh.shape)
         sp = shape.get("sp", 1)
         n_batch = 1
         for a in batch_axes:
             n_batch *= shape.get(a, 1)
-        # route to the ring only when shard_map's divisibility holds for
-        # EVERY operand dim it shards (self-attention, seq and batch dims
-        # divisible) — anything else silently keeps the dense/Pallas path
-        # that runs the same shapes without the scope
-        if (sp > 1
-                and q.shape[1] == k.shape[1] == v.shape[1]
-                and q.shape[1] % sp == 0
-                and q.shape[0] % max(n_batch, 1) == 0):
-            from ..parallel.ring import ring_self_attention
-
-            return ring_self_attention(
-                mesh, q, k, v, causal=causal, sm_scale=sm_scale,
-                batch_axes=batch_axes or None)
+        # route to the SP kernel only when shard_map's divisibility holds
+        # for EVERY operand dim it shards (self-attention, seq and batch
+        # dims divisible; Ulysses also shards heads) — anything else
+        # silently keeps the dense/Pallas path that runs the same shapes
+        # without the scope
+        ok = (sp > 1
+              and q.shape[1] == k.shape[1] == v.shape[1]
+              and q.shape[1] % sp == 0
+              and q.shape[0] % max(n_batch, 1) == 0)
+        if ok and mode == "ulysses":
+            ok = (q.shape[0] // max(n_batch, 1)) % sp == 0
+        if ok:
+            if mode == "ulysses":
+                from ..parallel.ulysses import ulysses_self_attention as sp_fn
+            else:
+                from ..parallel.ring import ring_self_attention as sp_fn
+            return sp_fn(mesh, q, k, v, causal=causal, sm_scale=sm_scale,
+                         batch_axes=batch_axes or None)
     from . import pallas as _pk
 
     if _pk.enabled() and _pk.use_compiled():

@@ -129,10 +129,12 @@ class DataParallelStep:
         per-chip batches (reference analog: MXNet memonger/mirror).
 
         ring_attention: with an active sp>1 axis, fused-attention ops in
-        the model lower to the ring kernel (K/V rotating over ICI via
-        ppermute, online softmax) instead of GSPMD's K/V all-gather —
-        per-device attention memory stays O((L/sp)^2) for long
-        sequences.
+        the model lower to a sequence-parallel kernel instead of GSPMD's
+        K/V all-gather.  True/'ring': K/V rotate over ICI via ppermute
+        (online softmax, per-device attention memory O((L/sp)^2)).
+        'ulysses': one all-to-all reshards heads so attention runs
+        locally over the full sequence (constant collective count; head
+        count must divide by sp).
 
         accum_steps: gradient accumulation INSIDE the fused step — the
         batch is split into accum_steps contiguous microbatches, each
@@ -169,6 +171,9 @@ class DataParallelStep:
         self._optimizer = optimizer
         self._donate = donate
         self._remat = remat
+        if ring_attention not in (True, False, "ring", "ulysses"):
+            raise MXNetError("ring_attention must be bool, 'ring' or "
+                             f"'ulysses', got {ring_attention!r}")
         self._ring = ring_attention
         if accum_steps < 1:
             raise MXNetError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -416,7 +421,8 @@ class DataParallelStep:
                 a for a in (tuple(x for x in self._batch_axes if x != "sp")
                             + ("tp",))
                 if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
-            ring_cm = ring_attention_scope(self.mesh, dim0_axes)
+            mode = self._ring if isinstance(self._ring, str) else "ring"
+            ring_cm = ring_attention_scope(self.mesh, dim0_axes, mode=mode)
         else:
             ring_cm = contextlib.nullcontext()
         mesh_platform = next(iter(self.mesh.devices.flat)).platform

@@ -18,7 +18,7 @@ _state = threading.local()
 
 
 def ring_scope() -> Optional[Tuple]:
-    """(mesh, batch_axes) of the innermost active scope, or None."""
+    """(mesh, batch_axes, mode) of the innermost active scope, or None."""
     return getattr(_state, "scope", None)
 
 
@@ -28,9 +28,17 @@ def ring_scope_mesh():
 
 
 @contextlib.contextmanager
-def ring_attention_scope(mesh, batch_axes: Tuple[str, ...] = ()):
+def ring_attention_scope(mesh, batch_axes: Tuple[str, ...] = (),
+                         mode: str = "ring"):
+    """mode: 'ring' (ppermute K/V rotation) or 'ulysses' (all-to-all head
+    resharding) — the two §5.7 sequence-parallel attention mechanisms."""
+    if mode not in ("ring", "ulysses"):
+        from ..base import MXNetError
+
+        raise MXNetError(f"unknown SP attention mode {mode!r} "
+                         "(expected 'ring' or 'ulysses')")
     prev = getattr(_state, "scope", None)
-    _state.scope = (mesh, tuple(batch_axes))
+    _state.scope = (mesh, tuple(batch_axes), mode)
     try:
         yield
     finally:

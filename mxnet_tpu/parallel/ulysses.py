@@ -26,16 +26,16 @@ from ..base import MXNetError
 __all__ = ["ulysses_self_attention"]
 
 
-def _dense_attn(q, k, v, causal, sm_scale):
-    s = jnp.einsum("nqd,nkd->nqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    if causal:
-        lq, lk = q.shape[1], k.shape[1]
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
-        s = jnp.where((qpos >= kpos)[None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("nqk,nkd->nqd", p, v.astype(jnp.float32)).astype(q.dtype)
+def _local_attn(q, k, v, causal, sm_scale):
+    """Per-device attention after the head reshard: the Pallas flash
+    kernel when enabled (no (L, L) score materialization — the point of
+    SP for long sequences), else the shared dense composition."""
+    from ..ops import pallas as _pk
+    from ..ops.contrib_ops import _dense_attention
+
+    if _pk.enabled() and _pk.use_compiled():
+        return _pk.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _dense_attention(q, k, v, causal, sm_scale)
 
 
 def ulysses_self_attention(mesh, q, k, v, causal: bool = False,
@@ -77,7 +77,7 @@ def ulysses_self_attention(mesh, q, k, v, causal: bool = False,
                                       tiled=True)
 
         qh, kh, vh = seq2head(q_l), seq2head(k_l), seq2head(v_l)
-        out = _dense_attn(qh, kh, vh, causal, sm_scale)
+        out = _local_attn(qh, kh, vh, causal, sm_scale)
         return head2seq(out)
 
     spec = P(tuple(batch_axes) if batch_axes else None, axis, None)

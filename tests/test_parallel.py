@@ -210,6 +210,8 @@ def test_ring_attention_training_step_parity():
         return losses
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+    # Ulysses mode: same losses through the all-to-all SP route
+    np.testing.assert_allclose(run("ulysses"), run(False), rtol=2e-4)
 
     # routing proof: under the scope the op lowers to ppermute rotations
     # (collective-permute in the compiled module), not a K/V all-gather
@@ -225,6 +227,15 @@ def test_ring_attention_training_step_parity():
         txt = jax.jit(lambda a, b, c: op.fn(a, b, c, causal=True)).lower(
             qj, qj, qj).compile().as_text()
     assert "collective-permute" in txt
+    # ...and the ulysses mode lowers to all-to-all resharding, so its
+    # parity above cannot have passed vacuously through the dense path
+    with _pk.compute_on("cpu"), ring_attention_scope(mesh, mode="ulysses"):
+        txt_u = jax.jit(lambda a, b, c: op.fn(a, b, c, causal=True)).lower(
+            qj, qj, qj).compile().as_text()
+    assert "all-to-all" in txt_u, txt_u[:500]
+    with pytest.raises(mx.MXNetError):
+        with ring_attention_scope(mesh, mode="ullyses"):
+            pass
 
 
 def test_pipeline_apply_matches_sequential():
