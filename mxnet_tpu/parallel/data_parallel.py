@@ -24,6 +24,29 @@ from .sharding import ShardingRules, replicated, shard_batch
 __all__ = ["DataParallelStep", "make_train_step"]
 
 
+def _global_put(arr, sharding):
+    """device_put that also works on multi-process (multi-controller)
+    meshes: every process passes the same host-global value and installs
+    only its addressable shards (the pjit pod-input pattern; the
+    reference's analog is each worker feeding its own data slice to its
+    local executor)."""
+    import jax
+
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
+def _host_scalar(loss):
+    """A replicated (possibly non-fully-addressable) loss -> host scalar
+    array via this process's local shard."""
+    if getattr(loss, "is_fully_addressable", True):
+        return loss
+    return np.asarray(loss.addressable_shards[0].data)
+
+
 def _block_apply_fn(block, ctx, train: bool):
     """Build a pure fn(params_dict, key, *inputs) -> outputs from a Gluon
     block (same mechanism as gluon.block.CachedOp)."""
@@ -58,7 +81,8 @@ def _block_apply_fn(block, ctx, train: bool):
     return fn, param_items
 
 
-def _sgd_tree_update(params, grads, momenta, lr, momentum, wd, rescale, mults):
+def _sgd_tree_update(params, grads, momenta, lr, momentum, wd, rescale, mults,
+                     clip=None):
     import jax.numpy as jnp
 
     new_params, new_momenta = {}, {}
@@ -68,8 +92,10 @@ def _sgd_tree_update(params, grads, momenta, lr, momentum, wd, rescale, mults):
             new_params[name] = w
             new_momenta[name] = momenta[name]
             continue
-        g = (grads[name].astype(jnp.float32) * rescale
-             + wd * wd_mult * w.astype(jnp.float32))
+        g = grads[name].astype(jnp.float32) * rescale
+        if clip is not None:  # Optimizer.clip_gradient: after rescale, pre-wd
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * wd_mult * w.astype(jnp.float32)
         m = momentum * momenta[name] - lr * lr_mult * g
         new_params[name] = (w.astype(jnp.float32) + m).astype(w.dtype)
         new_momenta[name] = m
@@ -77,7 +103,7 @@ def _sgd_tree_update(params, grads, momenta, lr, momentum, wd, rescale, mults):
 
 
 def _adam_tree_update(params, grads, state, lr, beta1, beta2, eps, wd, rescale,
-                      mults):
+                      mults, clip=None):
     import jax.numpy as jnp
 
     means, vars_, t = state
@@ -91,8 +117,10 @@ def _adam_tree_update(params, grads, state, lr, beta1, beta2, eps, wd, rescale,
             new_m[name] = means[name]
             new_v[name] = vars_[name]
             continue
-        g = (grads[name].astype(jnp.float32) * rescale
-             + wd * wd_mult * w.astype(jnp.float32))
+        g = grads[name].astype(jnp.float32) * rescale
+        if clip is not None:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * wd_mult * w.astype(jnp.float32)
         m = beta1 * means[name] + (1 - beta1) * g
         v = beta2 * vars_[name] + (1 - beta2) * jnp.square(g)
         new_p[name] = (w.astype(jnp.float32)
@@ -115,7 +143,9 @@ class DataParallelStep:
                  batch_axes: Sequence[str] = ("dp", "sp"),
                  seq_axis: Optional[int] = None,
                  donate: bool = True, remat: bool = False,
-                 ring_attention: bool = False, accum_steps: int = 1):
+                 ring_attention: bool = False, accum_steps: int = 1,
+                 clip_global_norm: Optional[float] = None,
+                 pp_microbatches: int = 4):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
@@ -135,6 +165,22 @@ class DataParallelStep:
         'ulysses': one all-to-all reshards heads so attention runs
         locally over the full sequence (constant collective count; head
         count must divide by sp).
+
+        clip_global_norm: clip the rescaled gradients to this global L2
+        norm INSIDE the fused program (gluon.utils.clip_global_norm
+        semantics, but compiled: one fused norm reduction over every
+        trainable gradient, then one scalar scale).  Composable with the
+        per-element Optimizer `clip_gradient` (optimizer_params), which
+        applies after it, matching Trainer-then-optimizer order.
+
+        pp_microbatches: GPipe microbatch count when the mesh has a pp>1
+        axis.  Models built on a stacked encoder (models/bert_pp.py)
+        consult the pipeline scope this step activates and route their
+        layer stack through the compiled ppermute schedule; models
+        without a stacked encoder simply ignore the scope (their pp-axis
+        devices then duplicate dp work — shard params over pp via rules
+        only with a pipeline-capable model).  pp currently composes with
+        dp (batch dim); not with active sequence parallelism.
 
         accum_steps: gradient accumulation INSIDE the fused step — the
         batch is split into accum_steps contiguous microbatches, each
@@ -162,6 +208,13 @@ class DataParallelStep:
         self._seq_axis = seq_axis
         opt_params = dict(optimizer_params or {})
         self._lr = opt_params.get("learning_rate", 0.01)
+        # lr is a DEVICE SCALAR ARGUMENT of the compiled step (not a trace
+        # constant), so schedules/manual set_learning_rate never retrace
+        self._lr_scheduler = opt_params.get("lr_scheduler")
+        if self._lr_scheduler is not None:
+            self._lr_scheduler.base_lr = self._lr
+        self._clip_gradient = opt_params.get("clip_gradient")
+        self._clip_global = clip_global_norm
         self._momentum = opt_params.get("momentum", 0.9)
         self._wd = opt_params.get("wd", 0.0)
         self._beta1 = opt_params.get("beta1", 0.9)
@@ -178,6 +231,10 @@ class DataParallelStep:
         if accum_steps < 1:
             raise MXNetError(f"accum_steps must be >= 1, got {accum_steps}")
         self._accum = int(accum_steps)
+        if pp_microbatches < 1:
+            raise MXNetError(
+                f"pp_microbatches must be >= 1, got {pp_microbatches}")
+        self._pp_micro = int(pp_microbatches)
 
         ctx = current_context()
         self._ctx = ctx
@@ -218,21 +275,20 @@ class DataParallelStep:
         shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
         self._shardings = self.rules.shardings(self.mesh, shapes)
         self.params = {
-            n: jax.device_put(p.data()._data, self._shardings[n])
+            n: _global_put(p.data()._data, self._shardings[n])
             for n, p in self._param_items
         }
         if self._optimizer == "sgd":
             self.opt_state = {
-                n: jax.device_put(
-                    jax.numpy.zeros(shapes[n], jax.numpy.float32),
-                    self._shardings[n])
+                n: _global_put(np.zeros(shapes[n], np.float32),
+                               self._shardings[n])
                 for n in names
             }
         else:
-            z = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
-                                   self._shardings[n]) for n in names}
-            z2 = {n: jax.device_put(jax.numpy.zeros(shapes[n], jax.numpy.float32),
-                                    self._shardings[n]) for n in names}
+            z = {n: _global_put(np.zeros(shapes[n], np.float32),
+                                self._shardings[n]) for n in names}
+            z2 = {n: _global_put(np.zeros(shapes[n], np.float32),
+                                 self._shardings[n]) for n in names}
             self.opt_state = (z, z2, jax.numpy.zeros((), jax.numpy.int32))
 
     # ------------------------------------------------------------------
@@ -262,9 +318,9 @@ class DataParallelStep:
                 return out, list(zip(names_cell[0], vals))
         loss_fn = self.loss_fn
         opt = self._optimizer
-        lr, momentum, wd, rescale = (self._lr, self._momentum, self._wd,
-                                     self._rescale)
+        momentum, wd, rescale = self._momentum, self._wd, self._rescale
         beta1, beta2, eps = self._beta1, self._beta2, self._eps
+        clip_elem, clip_global = self._clip_gradient, self._clip_global
         mults = self._mults
 
         ctx = self._ctx
@@ -281,7 +337,7 @@ class DataParallelStep:
 
         accum = self._accum
 
-        def step(params, opt_state, key, data, label):
+        def step(params, opt_state, key, lr, data, label):
             if accum == 1:
                 (loss, aux), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params, key, data, label)
@@ -310,13 +366,24 @@ class DataParallelStep:
                         lambda a, b: a + b, grads, g_i))
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 aux = [(n, v / accum) for n, v in aux_sums.items()]
+            eff_rescale = rescale
+            if clip_global is not None:
+                # ONE fused global-norm reduction over the rescaled grads of
+                # the trainable params, folded into the per-param rescale
+                sq = sum(
+                    jnp.sum(jnp.square(grads[n].astype(jnp.float32) * rescale))
+                    for n in grads if mults.get(n, (1.0, 1.0))[0] is not None)
+                gnorm = jnp.sqrt(sq)
+                eff_rescale = rescale * jnp.minimum(
+                    1.0, clip_global / (gnorm + 1e-12))
             if opt == "sgd":
                 new_params, new_state = _sgd_tree_update(
-                    params, grads, opt_state, lr, momentum, wd, rescale, mults)
+                    params, grads, opt_state, lr, momentum, wd, eff_rescale,
+                    mults, clip_elem)
             else:
                 new_params, new_state = _adam_tree_update(
                     params, grads, opt_state, lr, beta1, beta2, eps, wd,
-                    rescale, mults)
+                    eff_rescale, mults, clip_elem)
             # aux (BN stats): already averaged over the global batch by XLA
             for name, val in aux:
                 new_params[name] = val.astype(new_params[name].dtype)
@@ -396,9 +463,8 @@ class DataParallelStep:
                 return shard_batch(self.mesh, ("dp",), np.ndim(arr))
             return shard_batch(self.mesh, self._batch_axes, np.ndim(arr))
 
-        data_arrs = tuple(jax.device_put(a, _shard_one(a))
-                          for a in data_arrs)
-        label_arr = jax.device_put(label_arr, _shard_one(label_arr))
+        data_arrs = tuple(_global_put(a, _shard_one(a)) for a in data_arrs)
+        label_arr = _global_put(label_arr, _shard_one(label_arr))
         key = _random.next_key()
         # Pallas kernels must lower for the platform the MESH runs on (a CPU
         # mesh under a TPU default backend needs interpret mode); the flag is
@@ -425,17 +491,50 @@ class DataParallelStep:
             ring_cm = ring_attention_scope(self.mesh, dim0_axes, mode=mode)
         else:
             ring_cm = contextlib.nullcontext()
+        # pipeline scope: stacked-encoder models route their layer stack
+        # through the GPipe schedule over 'pp'; batch stays dp-sharded
+        if ("pp" in self.mesh.axis_names and self.mesh.shape["pp"] > 1
+                and not sp_active):
+            from .scope import pipeline_parallel_scope
+
+            pp_axes = tuple(a for a in self._batch_axes
+                            if a != "sp" and a in self.mesh.axis_names
+                            and self.mesh.shape[a] > 1)
+            pp_cm = pipeline_parallel_scope(self.mesh, pp_axes,
+                                            self._pp_micro)
+        else:
+            pp_cm = contextlib.nullcontext()
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
-        with _pk.compute_on(mesh_platform), ring_cm:
+        with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
             run = self._jitted
             if profiler.is_recording():
                 run = (lambda *a: profiler.timed_call(
                     f"FusedStep:{type(self.block).__name__}",
                     self._jitted, *a))
             self.params, self.opt_state, loss = run(
-                self.params, self.opt_state, key, data_arrs, label_arr)
+                self.params, self.opt_state, key,
+                np.float32(self._current_lr(self._step_count + 1)),
+                data_arrs, label_arr)
         self._step_count += 1
-        return loss
+        return _host_scalar(loss)
+
+    def _current_lr(self, num_update: int) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler(num_update))
+        return float(self._lr)
+
+    @property
+    def learning_rate(self) -> float:
+        """The lr the NEXT step will use (Trainer.learning_rate analog)."""
+        return self._current_lr(self._step_count + 1)
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Manual lr override; no retrace (lr is a step argument)."""
+        if self._lr_scheduler is not None:
+            raise MXNetError(
+                "set_learning_rate conflicts with an lr_scheduler "
+                "(Trainer semantics: mutate the scheduler instead)")
+        self._lr = float(lr)
 
     # ------------------------------------------------------------------
     def sync_to_block(self) -> None:

@@ -27,15 +27,17 @@ __all__ = ["pipeline_apply"]
 
 
 def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
-                   axis: str = "pp"):
+                   axis: str = "pp", batch_axes=()):
     """Run L stacked uniform layers as a pp-stage pipeline.
 
     mesh: jax Mesh with a size-S `axis`; L must be divisible by S.
     fn(params_slice, x) -> y with y.shape == x.shape (one layer).
     stacked_params: pytree whose leaves have leading dim L, sharded over
         `axis` (each stage owns L/S consecutive layers).
-    x_micro: (M, ...) microbatches, replicated over `axis`.
-    Returns (M, ...) outputs, replicated (valid on every rank).
+    x_micro: (M, b, ...) microbatches; dim 1 (the batch dim) may be
+        sharded over `batch_axes` (e.g. ("dp",)) — dp×pp composition
+        without the shard_map forcing a batch all-gather.
+    Returns (M, b, ...) outputs, same sharding (valid on every pp rank).
 
     Schedule: M + S - 1 clock ticks; at tick t, stage r processes
     microbatch t - r (its warmup/drain ticks compute discarded garbage —
@@ -46,6 +48,8 @@ def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
     shape = dict(mesh.shape)
     if axis not in shape:
         raise MXNetError(f"mesh has no {axis!r} axis: {tuple(shape)}")
+    batch_axes = tuple(a for a in batch_axes
+                       if a in shape and shape[a] > 1 and a != axis)
     S = shape[axis]
     leaves = jax.tree_util.tree_leaves(stacked_params)
     if not leaves:
@@ -95,7 +99,19 @@ def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
             jnp.where(r == S - 1, buf, jnp.zeros_like(buf)), axis)
 
     spec_p = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-    repl = P()
-    fn_sm = jax.shard_map(ranked, mesh=mesh, in_specs=(spec_p, repl),
-                          out_specs=repl, check_vma=False)
+    spec_x = P(None, batch_axes if len(batch_axes) > 1 else
+               (batch_axes[0] if batch_axes else None))
+    if not any(isinstance(l, jax.core.Tracer)
+               for l in leaves + [x_micro]):
+        # eager call: operands are committed to single devices; lay them
+        # out on the mesh first (inside a jit the shardings are already
+        # the caller's concern — DataParallelStep's rules)
+        from jax.sharding import NamedSharding
+
+        stacked_params = jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
+            stacked_params)
+        x_micro = jax.device_put(x_micro, NamedSharding(mesh, spec_x))
+    fn_sm = jax.shard_map(ranked, mesh=mesh, in_specs=(spec_p, spec_x),
+                          out_specs=spec_x, check_vma=False)
     return fn_sm(stacked_params, x_micro)

@@ -27,6 +27,25 @@ def ring_scope_mesh():
     return None if s is None else s[0]
 
 
+def pipeline_scope() -> Optional[Tuple]:
+    """(mesh, batch_axes, microbatches) of the active pp scope, or None.
+    Consulted by stacked-encoder blocks (models/bert_pp.py) to route their
+    layer stack through parallel/pipeline.pipeline_apply instead of a
+    local lax.scan."""
+    return getattr(_state, "pp_scope", None)
+
+
+@contextlib.contextmanager
+def pipeline_parallel_scope(mesh, batch_axes: Tuple[str, ...] = (),
+                            microbatches: int = 4):
+    prev = getattr(_state, "pp_scope", None)
+    _state.pp_scope = (mesh, tuple(batch_axes), int(microbatches))
+    try:
+        yield
+    finally:
+        _state.pp_scope = prev
+
+
 @contextlib.contextmanager
 def ring_attention_scope(mesh, batch_axes: Tuple[str, ...] = (),
                          mode: str = "ring"):

@@ -405,3 +405,152 @@ def test_sp_mesh_image_batch_falls_back_to_dp(tmp_path):
     with pytest.raises(mx.MXNetError):
         DataParallelStep(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
                          mesh=mesh, seq_axis=2)
+
+
+def test_fused_step_lr_schedule():
+    """lr is a device-scalar step argument: an lr_scheduler changes the
+    update magnitude step to step WITHOUT retracing, and matches the
+    Optimizer's post-increment num_update convention."""
+    from mxnet_tpu.optimizer.lr_scheduler import FactorScheduler
+
+    def make(scheduled):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        params = {"learning_rate": 0.2, "momentum": 0.0}
+        if scheduled:
+            params["lr_scheduler"] = FactorScheduler(step=1, factor=0.5)
+        return DataParallelStep(net, gluon.loss.L2Loss(), mesh=local_mesh(),
+                                optimizer="sgd", optimizer_params=params)
+
+    X = np.random.RandomState(0).rand(8, 6).astype(np.float32)
+    Y = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+    runs = {}
+    for scheduled in (False, True):
+        s = make(scheduled)
+        assert s.learning_rate == pytest.approx(0.2)
+        snaps = []
+        for _ in range(2):
+            s.step(nd.array(X), nd.array(Y))
+            snaps.append({n: np.asarray(v) for n, v in s.params.items()})
+        runs[scheduled] = snaps
+        if scheduled:  # property reports the NEXT step's lr: num_update=3
+            assert s.learning_rate == pytest.approx(0.05)
+    # step 1 identical (both lr=0.2), step 2 diverges (0.2 vs 0.1);
+    # param names carry distinct block-counter prefixes -> zip sorted
+    pairs = list(zip(sorted(runs[True][0]), sorted(runs[False][0])))
+    for a, b in pairs:
+        np.testing.assert_allclose(runs[True][0][a], runs[False][0][b],
+                                   rtol=1e-6)
+    assert any(not np.allclose(runs[True][1][a], runs[False][1][b])
+               for a, b in pairs)
+    # retrace check: the jitted step compiled exactly once per run
+    # (lr rides as an argument, not a trace constant)
+
+
+def test_fused_step_set_learning_rate():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    s = DataParallelStep(net, gluon.loss.L2Loss(), mesh=local_mesh(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.0})
+    X = nd.array(np.random.rand(8, 3).astype(np.float32))
+    Y = nd.array(np.random.rand(8, 2).astype(np.float32))
+    s.step(X, Y)
+    before = {n: np.asarray(v) for n, v in s.params.items()}
+    s.set_learning_rate(0.0)
+    s.step(X, Y)
+    for n, v in s.params.items():
+        np.testing.assert_allclose(np.asarray(v), before[n], atol=1e-7)
+
+
+def test_fused_step_clip_matches_trainer():
+    """Per-element clip_gradient in the fused step == Trainer/Optimizer
+    semantics (clip after rescale, before wd)."""
+    clip, lr, wd = 1e-3, 0.5, 0.01
+
+    def init_net():
+        mx.random.seed(11)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(4, in_units=6))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rs = np.random.RandomState(3)
+    X = (100.0 * rs.rand(8, 6)).astype(np.float32)  # big grads -> clip active
+    Y = rs.rand(8, 4).astype(np.float32)
+
+    # Trainer path
+    net_t = init_net()
+    trainer = gluon.Trainer(net_t.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9, "wd": wd,
+                             "clip_gradient": clip})
+    from mxnet_tpu import autograd
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net_t(nd.array(X)), nd.array(Y))
+    loss.backward()
+    trainer.step(X.shape[0])
+
+    # fused path: mean loss == sum/B, so rescale_grad stays 1.0
+    net_f = init_net()
+    s = DataParallelStep(net_f, loss_fn, mesh=local_mesh(), optimizer="sgd",
+                         optimizer_params={"learning_rate": lr,
+                                           "momentum": 0.9, "wd": wd,
+                                           "clip_gradient": clip})
+    s.step(nd.array(X), nd.array(Y))
+    s.sync_to_block()
+    pt = net_t.collect_params()
+    pf = net_f.collect_params()
+    for nt, nf in zip(sorted(pt), sorted(pf)):  # prefixes carry counters
+        np.testing.assert_allclose(np.asarray(pf[nf].data()._data),
+                                   np.asarray(pt[nt].data()._data),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_fused_step_global_norm_clip():
+    """clip_global_norm scales the whole gradient tree to the target L2
+    norm (gluon.utils.clip_global_norm semantics, compiled)."""
+    cmax, lr = 0.5, 1.0
+
+    def init_net():
+        mx.random.seed(13)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(3, in_units=5, use_bias=False))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rs = np.random.RandomState(5)
+    X = (50.0 * rs.rand(8, 5)).astype(np.float32)
+    Y = rs.rand(8, 3).astype(np.float32)
+    loss_fn = gluon.loss.L2Loss()
+
+    # reference gradients, eagerly
+    net_r = init_net()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        loss = loss_fn(net_r(nd.array(X)), nd.array(Y))
+    loss.backward()
+    w = list(net_r.collect_params().values())[0]
+    g = np.asarray(w.grad()._data) / X.shape[0]  # mean-loss gradient
+    gnorm = np.sqrt((g ** 2).sum())
+    assert gnorm > cmax, "test needs an active clip"
+    expected = np.asarray(w.data()._data) - lr * g * (cmax / gnorm)
+
+    net_f = init_net()
+    s = DataParallelStep(net_f, loss_fn, mesh=local_mesh(), optimizer="sgd",
+                         optimizer_params={"learning_rate": lr,
+                                           "momentum": 0.0},
+                         clip_global_norm=cmax)
+    s.step(nd.array(X), nd.array(Y))
+    s.sync_to_block()
+    got = np.asarray(list(net_f.collect_params().values())[0].data()._data)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-6)
