@@ -1,0 +1,87 @@
+"""Detection training on synthetic boxes: SSD or Faster-RCNN (BASELINE
+config 5; reference: example/ssd/train.py + example/rcnn/train_end2end.py).
+
+    python examples/train_detection.py --model ssd --steps 20
+    python examples/train_detection.py --model faster_rcnn --steps 12
+"""
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import (FasterRCNNTrainLoss, SSDTrainLoss,
+                              faster_rcnn_small, ssd_300)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ssd",
+                    choices=["ssd", "faster_rcnn"])
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--image-size", type=int, default=96)
+    ap.add_argument("--num-classes", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--device", default="auto", choices=["auto", "cpu"])
+    args = ap.parse_args()
+    if args.device == "cpu":
+        mx.context.pin_platform("cpu")
+
+    mx.random.seed(0)
+    B, S = args.batch_size, args.image_size
+    x = nd.array(np.random.RandomState(0).rand(B, 3, S, S)
+                 .astype(np.float32))
+
+    if args.model == "ssd":
+        net = ssd_300(num_classes=args.num_classes)
+        net.initialize(mx.init.Xavier())
+        loss_block = SSDTrainLoss()
+        # SSD labels are normalized corner boxes [cls, x1, y1, x2, y2]
+        labels = nd.array(np.tile(
+            np.array([[[0, 0.25, 0.25, 0.75, 0.75]]], np.float32),
+            (B, 1, 1)))
+
+        def forward():
+            anchors, cls_preds, box_preds = net(x)
+            return loss_block(anchors, cls_preds, box_preds, labels)
+    else:
+        net = faster_rcnn_small(num_classes=args.num_classes)
+        net.initialize(mx.init.Xavier())
+        loss_block = FasterRCNNTrainLoss(net)
+        # RCNN gt boxes are PIXEL corner boxes [cls, x1, y1, x2, y2]
+        gt = nd.array(np.tile(np.array(
+            [[[0, S // 4, S // 4, 3 * S // 4, 3 * S // 4]]], np.float32),
+            (B, 1, 1)))
+        im_info = nd.array(np.tile(
+            np.array([[S, S, 1.0]], np.float32), (B, 1)))
+
+        def forward():
+            return loss_block(x, gt, im_info)
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    t0 = time.perf_counter()
+    first = last = None
+    for i in range(args.steps):
+        with autograd.record():
+            loss = forward()
+        loss.backward()
+        trainer.step(B)
+        last = float(loss.asnumpy().mean())
+        if first is None:
+            first = last
+        if i % 5 == 0:
+            print(f"step {i}: loss={last:.4f}  "
+                  f"{(i + 1) * B / (time.perf_counter() - t0):.1f} img/s")
+    print(f"{args.model}: loss {first:.4f} -> {last:.4f} "
+          f"({args.steps} steps)")
+    assert np.isfinite(last)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Faster-RCNN two-stage detector (BASELINE config 5, second half).
+
+Reference parity: example/rcnn/symbol/symbol_resnet.py ~L1-300 (RPN over a
+conv body, Proposal, ROI pooling, cls+bbox heads) plus the contrib ops
+proposal.cc / proposal_target.cc and the numpy AnchorLoader.
+
+TPU-native shape: the ENTIRE training step — backbone, RPN, anchor
+targets, proposal generation + NMS, proposal targets, ROIAlign, both
+heads, all four losses — is static-shape and compiles to ONE XLA program
+(the reference splits this across CUDA ops, host numpy target assignment,
+and a special AnchorLoader data iter).  Random fg/bg subsampling is
+replaced by deterministic balanced normalization (RPN) and IoU-ranked
+selection (RCNN): see _contrib_RPNAnchorTarget / _contrib_ProposalTarget.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import HybridBlock, loss as gloss, nn
+
+__all__ = ["FasterRCNN", "FasterRCNNTrainLoss", "faster_rcnn_small"]
+
+
+def _conv_block(channels):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels, 3, padding=1, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"))
+    return blk
+
+
+def _down_sample(channels):
+    blk = nn.HybridSequential()
+    blk.add(_conv_block(channels), _conv_block(channels), nn.MaxPool2D(2))
+    return blk
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector: conv body -> RPN -> proposals -> ROIAlign ->
+    cls/bbox heads.
+
+    forward(x) returns (feat, rpn_cls (B, 2A, H, W), rpn_bbox (B, 4A, H, W));
+    `rcnn_head` runs stage two on a given roi set; `detect` is the
+    end-to-end inference path.
+    """
+
+    def __init__(self, num_classes, base_channels=(16, 32, 64),
+                 rpn_channels=128, scales=(2.0, 4.0), ratios=(0.5, 1.0, 2.0),
+                 rpn_pre_nms=256, rpn_post_nms=64, rpn_min_size=4,
+                 rois_per_image=32, fg_fraction=0.5, roi_size=(7, 7),
+                 hidden=256, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.num_classes = num_classes
+        self._scales = tuple(float(s) for s in scales)
+        self._ratios = tuple(float(r) for r in ratios)
+        self._num_anchors = len(self._scales) * len(self._ratios)
+        self._stride = 2 ** len(base_channels)
+        self._rpn_pre = rpn_pre_nms
+        self._rpn_post = rpn_post_nms
+        self._rpn_min = rpn_min_size
+        self._rois_per_image = rois_per_image
+        self._fg_fraction = fg_fraction
+        self._roi_size = tuple(roi_size)
+        a = self._num_anchors
+        with self.name_scope():
+            body = nn.HybridSequential()
+            for c in base_channels:
+                body.add(_down_sample(c))
+            self.body = body
+            self.rpn_conv = nn.Conv2D(rpn_channels, 3, padding=1,
+                                      activation="relu", prefix="rpn_conv_")
+            self.rpn_cls = nn.Conv2D(2 * a, 1, prefix="rpn_cls_")
+            self.rpn_bbox = nn.Conv2D(4 * a, 1, prefix="rpn_bbox_")
+            top = nn.HybridSequential(prefix="top_")
+            top.add(nn.Dense(hidden, activation="relu", flatten=False),
+                    nn.Dense(hidden, activation="relu", flatten=False))
+            self.top = top
+            self.cls_head = nn.Dense(num_classes + 1, flatten=False,
+                                     prefix="cls_head_")
+            self.bbox_head = nn.Dense(4 * (num_classes + 1), flatten=False,
+                                      prefix="bbox_head_")
+
+    def hybrid_forward(self, F, x):
+        feat = self.body(x)
+        r = self.rpn_conv(feat)
+        return feat, self.rpn_cls(r), self.rpn_bbox(r)
+
+    # ------------------------------------------------------------------
+    def rpn_probs(self, F, rpn_cls):
+        """(B, 2A, H, W) logits -> Proposal-format probs (first A channels
+        bg, last A fg), via a pairwise sigmoid (== 2-way softmax)."""
+        a = self._num_anchors
+        bg = F.slice_axis(rpn_cls, axis=1, begin=0, end=a)
+        fg = F.slice_axis(rpn_cls, axis=1, begin=a, end=2 * a)
+        p = F.sigmoid(fg - bg)
+        return F.concat(1.0 - p, p, dim=1)
+
+    def proposals(self, F, rpn_cls, rpn_bbox, im_info):
+        """Decoded + NMS'd rois (B*post, 5); gradients are blocked, as in
+        the reference (proposals are inputs to stage 2, not a grad path)."""
+        cp = self.rpn_probs(F, F.stop_gradient(rpn_cls))
+        return F.contrib.Proposal(
+            cp, F.stop_gradient(rpn_bbox), im_info,
+            rpn_pre_nms_top_n=self._rpn_pre,
+            rpn_post_nms_top_n=self._rpn_post,
+            rpn_min_size=self._rpn_min, scales=self._scales,
+            ratios=self._ratios, feature_stride=self._stride)
+
+    def rcnn_head(self, F, feat, rois):
+        """Stage two: ROIAlign -> 2 fc -> (cls (R, C+1), bbox (R, 4(C+1)))."""
+        pooled = F.contrib.ROIAlign(feat, rois, pooled_size=self._roi_size,
+                                    spatial_scale=1.0 / self._stride)
+        h = self.top(pooled.reshape((pooled.shape[0], -1)))
+        return self.cls_head(h), self.bbox_head(h)
+
+    # ------------------------------------------------------------------
+    def detect(self, x, im_info=None, threshold=0.05, nms_threshold=0.3,
+               topk=-1):
+        """End-to-end inference: (B, R, 6) rows [cls_id, score, x1, y1,
+        x2, y2], cls_id = -1 for suppressed/below-threshold rows."""
+        from .. import ndarray as F
+        from ..ndarray import NDArray  # noqa: F401
+
+        b = x.shape[0]
+        if im_info is None:
+            im_info = F.array(np.tile(
+                np.array([[x.shape[2], x.shape[3], 1.0]], np.float32),
+                (b, 1)), ctx=x.context)
+        feat, rpn_cls, rpn_bbox = self(x)
+        rois = self.proposals(F, rpn_cls, rpn_bbox, im_info)
+        cls_pred, bbox_pred = self.rcnn_head(F, feat, rois)
+        probs = F.softmax(cls_pred, axis=-1).asnumpy()      # (B*R, C+1)
+        deltas = bbox_pred.asnumpy().reshape(
+            -1, self.num_classes + 1, 4) * np.array(
+                [0.1, 0.1, 0.2, 0.2], np.float32)
+        rois_np = rois.asnumpy()
+        r_per = self._rpn_post
+        out = []
+        for i in range(b):
+            rows = []
+            for j in range(r_per):
+                k = i * r_per + j
+                c = int(probs[k, 1:].argmax()) + 1
+                score = float(probs[k, c])
+                roi = rois_np[k, 1:]
+                rw = roi[2] - roi[0] + 1.0
+                rh = roi[3] - roi[1] + 1.0
+                cx = roi[0] + rw / 2 + deltas[k, c, 0] * rw
+                cy = roi[1] + rh / 2 + deltas[k, c, 1] * rh
+                w = np.exp(np.clip(deltas[k, c, 2], -10, 10)) * rw
+                h = np.exp(np.clip(deltas[k, c, 3], -10, 10)) * rh
+                rows.append([c - 1, score, cx - w / 2, cy - h / 2,
+                             cx + w / 2, cy + h / 2])
+            out.append(rows)
+        dets = F.array(np.asarray(out, np.float32), ctx=x.context)
+        return F.contrib.box_nms(dets, overlap_thresh=nms_threshold,
+                                 valid_thresh=threshold, topk=topk,
+                                 coord_start=2, score_index=1, id_index=0,
+                                 force_suppress=False)
+
+
+class FasterRCNNTrainLoss(HybridBlock):
+    """All four Faster-RCNN losses in one hybridizable block:
+    RPN balanced sigmoid CE + RPN smooth-L1 (sigma=3) + RCNN softmax CE +
+    RCNN per-class smooth-L1 (reference: example/rcnn train_end2end.py).
+    """
+
+    def __init__(self, net: FasterRCNN, rpn_fg_overlap=0.7,
+                 rpn_bg_overlap=0.3, rcnn_fg_overlap=0.5,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.net = net
+        self._rpn_fg = rpn_fg_overlap
+        self._rpn_bg = rpn_bg_overlap
+        self._rcnn_fg = rcnn_fg_overlap
+        self._ce = gloss.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, x, gt_boxes, im_info):
+        net = self.net
+        a = net._num_anchors
+        b = x.shape[0]
+        feat, rpn_cls, rpn_bbox = net(x)
+
+        # ---- RPN targets + losses
+        labels, bt, bw = F.contrib.RPNAnchorTarget(
+            rpn_cls, gt_boxes, scales=net._scales, ratios=net._ratios,
+            feature_stride=net._stride, fg_overlap=self._rpn_fg,
+            bg_overlap=self._rpn_bg)
+        bg_l = F.slice_axis(rpn_cls, axis=1, begin=0, end=a) \
+                .transpose((0, 2, 3, 1)).reshape((0, -1))
+        fg_l = F.slice_axis(rpn_cls, axis=1, begin=a, end=2 * a) \
+                .transpose((0, 2, 3, 1)).reshape((0, -1))
+        logit = fg_l - bg_l                                   # (B, N)
+        y = F.maximum(labels, 0.0)
+        # stable sigmoid CE; fg and bg halves normalized separately — the
+        # static equivalent of the reference's 256-anchor balanced sample
+        ce = (F.relu(logit) - logit * y
+              + F.Activation(-F.abs(logit), act_type="softrelu"))
+        fg_m = (labels == 1.0).astype("float32")
+        bg_m = (labels == 0.0).astype("float32")
+        one = F.ones_like(fg_m.sum())
+        rpn_cls_loss = ((ce * fg_m).sum() / F.maximum(fg_m.sum(), one)
+                        + (ce * bg_m).sum() / F.maximum(bg_m.sum(), one))
+        rb = rpn_bbox.transpose((0, 2, 3, 1)).reshape((0, -1, 4))
+        rpn_box_loss = (F.smooth_l1((rb - bt) * bw, scalar=3.0).sum()
+                        / F.maximum(fg_m.sum(), one))
+
+        # ---- stage 2: proposals (grad-blocked), targets, head losses
+        rois = net.proposals(F, rpn_cls, rpn_bbox, im_info)
+        rois2, rlabels, rbt, rbw = F.contrib.ProposalTarget(
+            rois, gt_boxes, num_classes=net.num_classes + 1,
+            batch_images=b, batch_rois=b * net._rois_per_image,
+            fg_fraction=net._fg_fraction, fg_overlap=self._rcnn_fg)
+        cls_pred, bbox_pred = net.rcnn_head(F, feat, rois2)
+        rcnn_cls_loss = self._ce(cls_pred, rlabels).mean()
+        rfg = (rlabels > 0.0).astype("float32")
+        rcnn_box_loss = (F.smooth_l1((bbox_pred - rbt) * rbw,
+                                     scalar=1.0).sum()
+                         / F.maximum(rfg.sum(), one))
+        return rpn_cls_loss + rpn_box_loss + rcnn_cls_loss + rcnn_box_loss
+
+
+def faster_rcnn_small(num_classes=2, **kwargs) -> FasterRCNN:
+    """Small config for tests/smokes (stride-8 body, 6 anchors/cell)."""
+    return FasterRCNN(num_classes=num_classes, **kwargs)

@@ -457,16 +457,8 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W), im_info (B, 3)
     -> rois (B*post_n, 5) [batch_idx, x1, y1, x2, y2] (+ scores)."""
     b, _, fh, fw = cls_prob.shape
-    base = _generate_base_anchors([float(s) for s in scales],
-                                  [float(r) for r in ratios],
-                                  float(feature_stride))
-    a = base.shape[0]
-    shift_x = jnp.arange(fw, dtype=jnp.float32) * feature_stride
-    shift_y = jnp.arange(fh, dtype=jnp.float32) * feature_stride
-    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
-    shifts = jnp.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], -1)
-    anchors = (jnp.asarray(base)[None, :, :]
-               + shifts[:, None, :]).reshape((-1, 4))  # (HWA, 4)
+    a = len(scales) * len(ratios)
+    anchors = _rcnn_anchor_grid(scales, ratios, feature_stride, fh, fw)
     n = anchors.shape[0]
     pre_n = min(rpn_pre_nms_top_n, n) if rpn_pre_nms_top_n > 0 else n
     post_n = rpn_post_nms_top_n
@@ -630,3 +622,143 @@ def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, num_rois=None,
         onehot[..., None, None],
         (b, n, num_classes, ms_h, ms_w)).astype(targets.dtype)
     return mask_targets, mask_weights
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN training targets (reference: example/rcnn anchor-target logic
+# + src/operator/contrib/proposal_target.cc)
+# ---------------------------------------------------------------------------
+def _rcnn_anchor_grid(scales, ratios, stride, fh, fw):
+    """Pixel-space anchor grid in the (H, W, A)-fastest-A layout shared
+    with _contrib_Proposal -> (H*W*A, 4)."""
+    base = _generate_base_anchors([float(s) for s in scales],
+                                  [float(r) for r in ratios], float(stride))
+    shift_x = jnp.arange(fw, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(fh, dtype=jnp.float32) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], -1)
+    return (jnp.asarray(base)[None, :, :]
+            + shifts[:, None, :]).reshape((-1, 4))
+
+
+def _rcnn_encode(anchors, gt, stds=(1.0, 1.0, 1.0, 1.0)):
+    """Inverse of _contrib_Proposal's decode (+1 pixel convention)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0] + 1.0, 1e-6)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1] + 1.0, 1e-6)
+    gcx = gt[:, 0] + gw / 2
+    gcy = gt[:, 1] + gh / 2
+    t = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                   jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+    return t / jnp.asarray(stds, t.dtype)
+
+
+@register("_contrib_RPNAnchorTarget", differentiable=False)
+def rpn_anchor_target(cls_prob, gt_boxes, scales=(4.0, 8.0, 16.0, 32.0),
+                      ratios=(0.5, 1.0, 2.0), feature_stride=16,
+                      fg_overlap=0.7, bg_overlap=0.3):
+    """RPN training targets (reference: example/rcnn AnchorLoader/assign_anchor
+    ~L1-150, done there in numpy on the host per batch).
+
+    TPU-native: runs inside the training program on device, so the whole
+    Faster-RCNN step stays ONE XLA program.  Instead of the reference's
+    random 256-anchor subsample (dynamic, host RNG), every anchor keeps its
+    label and the LOSS normalizes fg/bg halves separately — the static,
+    deterministic equivalent of a balanced minibatch.
+
+    cls_prob: (B, 2A, H, W) — shape/layout donor for the anchor grid.
+    gt_boxes: (B, M, 5) rows [cls, x1, y1, x2, y2] in pixels, cls<0 pads.
+    Returns (labels (B, N) in {1 fg, 0 bg, -1 ignore},
+             bbox_targets (B, N, 4), bbox_weights (B, N, 1)), N = H*W*A in
+    the same (h, w, a) order as _contrib_Proposal.
+    """
+    b, c2a, fh, fw = cls_prob.shape
+    anchors = _rcnn_anchor_grid(scales, ratios, feature_stride, fh, fw)
+    assert anchors.shape[0] == (c2a // 2) * fh * fw, \
+        f"anchor spec {anchors.shape[0]//(fh*fw)} != cls channels {c2a//2}"
+
+    def one(gt):
+        valid_gt = gt[:, 0] >= 0
+        iou = _pair_iou(anchors, gt[:, 1:])              # (N, M)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_iou = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        # the best anchor of every gt is fg even below fg_overlap
+        # (reference rule); tolerance for fp ties
+        best_per_gt = iou.max(axis=0)
+        is_best = ((iou >= best_per_gt[None, :] - 1e-6)
+                   & valid_gt[None, :] & (iou > 0)).any(axis=1)
+        fg = (max_iou >= fg_overlap) | is_best
+        bg = (max_iou < bg_overlap) & ~fg
+        labels = jnp.where(fg, 1.0, jnp.where(bg, 0.0, -1.0))
+        t = _rcnn_encode(anchors, gt[best_gt, 1:])
+        w = fg.astype(jnp.float32)[:, None]
+        return labels, t * w, w
+
+    return jax.vmap(one)(gt_boxes)
+
+
+@register("_contrib_ProposalTarget", differentiable=False)
+def proposal_target(rois, gt_boxes, num_classes=21, batch_images=1,
+                    batch_rois=128, fg_fraction=0.25, fg_overlap=0.5,
+                    box_stds=(0.1, 0.1, 0.2, 0.2)):
+    """RCNN head training targets (reference: proposal_target.cc ~L1-250).
+
+    Static-shape redesign: gt boxes join the candidate set (as upstream),
+    matching is vectorized IoU, and the reference's RANDOM fg/bg subsample
+    becomes a deterministic ranking — all fg by IoU desc, then bg by IoU
+    desc (hardest negatives first) — truncated to batch_rois//batch_images
+    per image.  fg_fraction caps the fg half like the reference.
+
+    rois: (B*post, 5) [batch_idx, x1, y1, x2, y2] from _contrib_Proposal.
+    gt_boxes: (B, M, 5) rows [cls, x1, y1, x2, y2], cls<0 pads (0-based
+    foreground classes; output labels are 1-based, 0 = background).
+    Returns (rois_out (batch_rois, 5), labels (batch_rois,),
+             bbox_targets (batch_rois, 4*num_classes),
+             bbox_weights (batch_rois, 4*num_classes));
+    num_classes INCLUDES background (slot 0 never targeted).
+    """
+    b = int(batch_images)
+    per_img = int(batch_rois) // b
+    fg_quota = int(round(fg_fraction * per_img))
+    rois_img = rois.reshape(b, -1, 5)
+
+    def one(r, gt):
+        valid_gt = gt[:, 0] >= 0
+        cand = jnp.concatenate([r[:, 1:], gt[:, 1:]], axis=0)   # (P+M, 4)
+        iou = jnp.where(valid_gt[None, :],
+                        _pair_iou(cand, gt[:, 1:]), 0.0)        # (P+M, Mg)
+        max_iou = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        fg = max_iou >= fg_overlap
+        # rank: fg by IoU desc, capped at fg_quota, then bg by IoU desc
+        n = cand.shape[0]
+        fg_rank = jnp.argsort(jnp.argsort(-jnp.where(fg, max_iou, -1.0)))
+        bg_rank = jnp.argsort(jnp.argsort(-jnp.where(fg, -1.0, max_iou)))
+        key = jnp.where(fg & (fg_rank < fg_quota), fg_rank, fg_quota + bg_rank)
+        sel = jnp.argsort(key)[:per_img]
+        # every SELECTED roi above fg_overlap keeps its fg label: when bg
+        # candidates are scarce, over-quota fg can enter the batch, and
+        # labeling a >=0.5-IoU roi "background" would be an actively wrong
+        # signal (the reference drops unsampled fg; with static shapes the
+        # honest equivalent is to let the fg fraction exceed the cap)
+        sel_fg = fg[sel]
+        labels = jnp.where(sel_fg, gt[best_gt[sel], 0] + 1.0, 0.0)
+        t = _rcnn_encode(cand[sel], gt[best_gt[sel], 1:], box_stds)
+        # scatter the 4 target values into the matched class's slot
+        cls = labels.astype(jnp.int32)
+        onehot = jax.nn.one_hot(cls, num_classes, dtype=t.dtype)  # (R, C)
+        wt = (onehot * sel_fg[:, None]).repeat(4, axis=-1)        # (R, 4C)
+        targets = (onehot[:, :, None] * t[:, None, :]).reshape(
+            per_img, -1) * sel_fg[:, None]
+        return cand[sel], labels, targets, wt
+
+    out_rois, labels, targets, weights = jax.vmap(one)(rois_img, gt_boxes)
+    bidx = jnp.repeat(jnp.arange(b, dtype=out_rois.dtype), per_img)
+    rois_out = jnp.concatenate(
+        [bidx[:, None], out_rois.reshape(-1, 4)], axis=-1)
+    return (rois_out, labels.reshape(-1), targets.reshape(batch_rois, -1),
+            weights.reshape(batch_rois, -1))

@@ -65,3 +65,14 @@ def test_train_mnist_e2e():
         cwd=_REPO, capture_output=True, text=True, timeout=420)
     assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
     assert "MNIST example OK" in res.stdout
+
+
+def test_train_detection_e2e():
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples",
+                                      "train_detection.py"),
+         "--device", "cpu", "--model", "faster_rcnn", "--steps", "4",
+         "--image-size", "64", "--batch-size", "2"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "faster_rcnn: loss" in res.stdout, res.stdout[-500:]

@@ -1,0 +1,113 @@
+"""Faster-RCNN model family (BASELINE config 5 second half): target-op
+semantics, forward shapes, one-block train loss convergence, detect format.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import FasterRCNNTrainLoss, faster_rcnn_small
+
+
+def test_rpn_anchor_target_semantics():
+    """gt box gets at least one fg anchor; far anchors are bg; targets are
+    zero outside fg rows; layout length matches H*W*A."""
+    cls_prob = nd.zeros((1, 6, 8, 8))  # A=3 -> 2A=6
+    gt = nd.array(np.array([[[0, 8, 8, 24, 24]]], np.float32))
+    lab, bt, bw = nd.contrib.RPNAnchorTarget(
+        cls_prob, gt, scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+        feature_stride=8)
+    lab_np, bw_np, bt_np = lab.asnumpy(), bw.asnumpy(), bt.asnumpy()
+    assert lab_np.shape == (1, 8 * 8 * 3)
+    assert (lab_np == 1).sum() >= 1          # best-anchor rule
+    assert (lab_np == 0).sum() > 0           # plenty of background
+    np.testing.assert_allclose(bt_np * (1 - bw_np), 0.0)  # masked targets
+    # all-padding gt -> no fg anywhere
+    gt_pad = nd.array(np.full((1, 1, 5), -1.0, np.float32))
+    lab2, _, _ = nd.contrib.RPNAnchorTarget(
+        cls_prob, gt_pad, scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+        feature_stride=8)
+    assert (lab2.asnumpy() == 1).sum() == 0
+
+
+def test_proposal_target_semantics():
+    """gt rows join candidates (so fg always exists), labels are 1-based
+    classes, targets live only in the matched class slot."""
+    gt = nd.array(np.array(
+        [[[1, 10, 10, 30, 30], [-1, 0, 0, 0, 0]]], np.float32))
+    rois = np.zeros((4, 5), np.float32)
+    rois[:, 1:] = [[40, 40, 60, 60], [0, 0, 5, 5],
+                   [11, 11, 29, 29], [50, 0, 60, 10]]
+    ro, lb, tg, wt = nd.contrib.ProposalTarget(
+        nd.array(rois), gt, num_classes=3, batch_images=1, batch_rois=4,
+        fg_fraction=0.5)
+    lb_np, wt_np, tg_np = lb.asnumpy(), wt.asnumpy(), tg.asnumpy()
+    assert ro.shape == (4, 5) and tg.shape == (4, 12)
+    assert (lb_np == 2).sum() >= 1           # cls 1 -> label 2
+    fg_rows = lb_np > 0
+    # weights: exactly 4 ones in the matched class slot for fg rows
+    assert (wt_np[fg_rows].sum(axis=1) == 4).all()
+    assert (wt_np[~fg_rows] == 0).all()
+    np.testing.assert_allclose(tg_np * (1 - wt_np), 0.0)
+
+
+def _net(num_classes=1):
+    mx.random.seed(0)
+    net = faster_rcnn_small(num_classes=num_classes)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _batch(B=2, size=64):
+    x = nd.array(np.random.RandomState(0).rand(B, 3, size, size)
+                 .astype(np.float32))
+    gt = nd.array(np.tile(
+        np.array([[[0, 16, 16, 48, 48]]], np.float32), (B, 1, 1)))
+    im_info = nd.array(np.tile(
+        np.array([[size, size, 1.0]], np.float32), (B, 1)))
+    return x, gt, im_info
+
+
+def test_faster_rcnn_forward_shapes():
+    net = _net()
+    x, gt, im_info = _batch()
+    feat, rpn_cls, rpn_bbox = net(x)
+    A = net._num_anchors
+    assert feat.shape == (2, 64, 8, 8)
+    assert rpn_cls.shape == (2, 2 * A, 8, 8)
+    assert rpn_bbox.shape == (2, 4 * A, 8, 8)
+    from mxnet_tpu import ndarray as F
+    rois = net.proposals(F, rpn_cls, rpn_bbox, im_info)
+    assert rois.shape == (2 * net._rpn_post, 5)
+    cls_pred, bbox_pred = net.rcnn_head(F, feat, rois)
+    assert cls_pred.shape == (2 * net._rpn_post, 2)
+    assert bbox_pred.shape == (2 * net._rpn_post, 8)
+
+
+def test_faster_rcnn_train_step_decreases_loss():
+    net = _net()
+    loss_block = FasterRCNNTrainLoss(net)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    x, gt, im_info = _batch()
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            loss = loss_block(x, gt, im_info)
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asscalar()))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_faster_rcnn_detect_output_format():
+    net = _net(num_classes=2)
+    x, _, _ = _batch(B=1)
+    out = net.detect(x, threshold=0.0).asnumpy()
+    assert out.ndim == 3 and out.shape[2] == 6
+    ids = out[0, :, 0]
+    assert ((ids >= -1) & (ids < 2)).all()
+    kept = out[0][ids >= 0]
+    if len(kept):
+        assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
