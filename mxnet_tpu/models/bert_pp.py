@@ -1,0 +1,187 @@
+"""Pipeline-parallel BERT: a stacked-parameter encoder that routes through
+the compiled GPipe schedule (parallel/pipeline.py) over the 'pp' mesh axis.
+
+Reference: none — the reference's nearest analog is group2ctx manual
+placement with no microbatching (SURVEY §2.3); this is a novel capability
+held to that row's target.
+
+TPU-native design: every encoder layer shares ONE apply function; the L
+per-layer parameter tensors are STACKED along a leading dim that shards
+over 'pp' (each stage owns L/S layers).  Off the pp mesh the same stack
+runs as a `lax.scan` — one compiled layer body instead of L inlined
+copies, so even single-chip tracing/compile gets faster.  Embedding and
+the MLM head run on every rank (replicated compute, activations stay
+dp-sharded); stage placement of embed/head is unnecessary in the SPMD
+formulation because XLA already overlaps them with the schedule.
+
+Divergences from models/bert.py (documented): no dropout inside the
+stacked encoder (a per-layer key chain through scan+ppermute buys nothing
+for the pp parity/dryrun story), and no attention mask (full-sequence
+pretraining batches).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..parallel.sharding import ShardingRules
+from .transformer import PositionalEmbedding
+
+__all__ = ["StackedTransformerEncoder", "BERTForMLMPipelined",
+           "bert_pp_small", "bert_pp_sharding_rules"]
+
+
+class StackedTransformerEncoder(HybridBlock):
+    """L post-LN encoder layers with stacked (L, ...) parameters.
+
+    Matches TransformerEncoderCell semantics (post-LN, gelu FFN, fused
+    qkv) with dropout=0; see module docstring for the divergence note.
+    """
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._L = num_layers
+        self._units = units
+        self._hidden = hidden_size
+        self._heads = num_heads
+        self._head_dim = units // num_heads
+        L, U, H = num_layers, units, hidden_size
+        with self.name_scope():
+            g = self.params.get
+            self.qkv_weight = g("qkv_weight", shape=(L, 3 * U, U))
+            self.qkv_bias = g("qkv_bias", shape=(L, 3 * U))
+            self.proj_weight = g("proj_weight", shape=(L, U, U))
+            self.proj_bias = g("proj_bias", shape=(L, U))
+            self.ffn1_weight = g("ffn1_weight", shape=(L, H, U))
+            self.ffn1_bias = g("ffn1_bias", shape=(L, H))
+            self.ffn2_weight = g("ffn2_weight", shape=(L, U, H))
+            self.ffn2_bias = g("ffn2_bias", shape=(L, U))
+            self.ln1_gamma = g("ln1_gamma", shape=(L, U), init="ones")
+            self.ln1_beta = g("ln1_beta", shape=(L, U), init="zeros")
+            self.ln2_gamma = g("ln2_gamma", shape=(L, U), init="ones")
+            self.ln2_beta = g("ln2_beta", shape=(L, U), init="zeros")
+
+    # -- pure jnp layer body shared by scan and pipeline paths ---------
+    def _layer(self, p, x):
+        nh, hd = self._heads, self._head_dim
+
+        def ln(y, gamma, beta):
+            mu = y.mean(-1, keepdims=True)
+            var = ((y - mu) ** 2).mean(-1, keepdims=True)
+            return (y - mu) / jnp.sqrt(var + 1e-5) * gamma + beta
+
+        b, t, u = x.shape
+        qkv = x @ p["qkv_weight"].T + p["qkv_bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(y):  # (B, T, U) -> (B, nh, T, hd)
+            return y.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = (attn @ v).transpose(0, 2, 1, 3).reshape(b, t, u)
+        out = out @ p["proj_weight"].T + p["proj_bias"]
+        x = ln(x + out, p["ln1_gamma"], p["ln1_beta"])
+        h = x @ p["ffn1_weight"].T + p["ffn1_bias"]
+        h = jax.nn.gelu(h, approximate=False)
+        h = h @ p["ffn2_weight"].T + p["ffn2_bias"]
+        return ln(x + h, p["ln2_gamma"], p["ln2_beta"])
+
+    def hybrid_forward(self, F, x, **params):
+        from ..base import MXNetError
+        from ..ndarray import NDArray
+        from ..parallel.scope import pipeline_scope
+
+        stacked = {n: (p._data if isinstance(p, NDArray) else p)
+                   for n, p in params.items()}
+        xa = x._data if isinstance(x, NDArray) else x
+        pp = pipeline_scope()
+        if pp is None:
+            def body(c, pl):
+                return self._layer(pl, c), None
+
+            out, _ = jax.lax.scan(body, xa, stacked)
+        else:
+            from ..parallel.pipeline import pipeline_apply
+
+            mesh, batch_axes, m = pp
+            bsz = xa.shape[0]
+            if bsz % m:
+                raise MXNetError(
+                    f"batch {bsz} not divisible by pp microbatches {m}")
+            dp_total = 1
+            for a in batch_axes:
+                dp_total *= mesh.shape[a]
+            if (bsz // m) % dp_total:
+                raise MXNetError(
+                    f"per-microbatch batch {bsz // m} not divisible by the "
+                    f"data-parallel extent {dp_total} ({batch_axes}); lower "
+                    f"pp_microbatches or raise the batch size")
+            # strided microbatches (rows i::m): a dp-sharded batch dim
+            # stays dp-sharded per microbatch with zero data movement
+            xm = xa.reshape(bsz // m, m, *xa.shape[1:]).transpose(
+                1, 0, *range(2, xa.ndim + 1))
+            ym = pipeline_apply(mesh, self._layer, stacked, xm,
+                                batch_axes=batch_axes)
+            out = ym.transpose(1, 0, *range(2, ym.ndim)).reshape(xa.shape)
+            if not isinstance(out, jax.core.Tracer):
+                # eager call: bring the mesh-sharded result back to the
+                # input's device so downstream eager ops see one device
+                out = jax.device_put(out, next(iter(xa.devices())))
+        return NDArray(out, ctx=x.context) if isinstance(x, NDArray) else out
+
+
+class BERTForMLMPipelined(HybridBlock):
+    """BERT MLM with the stacked encoder; train with DataParallelStep over
+    a mesh whose 'pp' axis is >1 (plus 'dp') and rules from
+    bert_pp_sharding_rules()."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 dropout=0.1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_embed_")
+            self.pos_embed = PositionalEmbedding(max_length, units,
+                                                 prefix="pos_embed_")
+            self.embed_ln = nn.LayerNorm(in_channels=units,
+                                         prefix="embed_ln_")
+            self.embed_drop = nn.Dropout(dropout)
+            self.encoder = StackedTransformerEncoder(
+                num_layers, units, hidden_size, num_heads,
+                prefix="enc_stack_")
+            self.mlm_dense = nn.Dense(units, flatten=False,
+                                      prefix="mlm_dense_")
+            self.mlm_ln = nn.LayerNorm(in_channels=units, prefix="mlm_ln_")
+            self.decoder = nn.Dense(vocab_size, flatten=False,
+                                    prefix="decoder_")
+
+    def hybrid_forward(self, F, inputs):
+        x = self.embed_drop(self.embed_ln(
+            self.pos_embed(self.word_embed(inputs))))
+        seq = self.encoder(x)
+        h = self.mlm_ln(F.LeakyReLU(self.mlm_dense(seq), act_type="gelu"))
+        return self.decoder(h)
+
+
+def bert_pp_sharding_rules() -> ShardingRules:
+    """Stacked encoder params shard their LAYER dim over 'pp'; embeddings
+    and the MLM head stay replicated (they run on every rank)."""
+    return ShardingRules([
+        (r".*enc_stack_.*", ("pp",)),
+    ])
+
+
+def bert_pp_small(vocab_size=512, units=64, hidden_size=128, num_layers=4,
+                  num_heads=4, max_length=64, **kwargs) -> BERTForMLMPipelined:
+    return BERTForMLMPipelined(vocab_size=vocab_size, units=units,
+                               hidden_size=hidden_size,
+                               num_layers=num_layers, num_heads=num_heads,
+                               max_length=max_length, **kwargs)
