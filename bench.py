@@ -10,8 +10,11 @@ raise) during backend init or mid-compute, and has burned two rounds of
 driver benches.  This file is therefore an ORCHESTRATOR: it probes the
 TPU backend in a subprocess with a hard timeout, retries with backoff,
 runs the measurement itself in a subprocess with a hard timeout, and on
-any failure falls back to a CPU measurement — so it ALWAYS emits exactly
-one parseable JSON line on stdout and exits 0.
+any failure falls back to a CPU measurement — so it ALWAYS emits at least
+one parseable JSON line on stdout and exits 0.  The LAST parseable line
+is authoritative: the primary metric prints as soon as it exists, and a
+second line with the merged {primary + "secondary": BERT} object follows
+when the secondary measurement also completes.
 
 Child modes (internal):
     python bench.py --probe            # init axon backend, print device list
@@ -30,12 +33,64 @@ PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
 # failure mode never recovers, and the budget must leave room for the
 # CPU-fallback measurement inside the driver's own timeout
 PROBE_BACKOFFS = (5.0, 20.0)
+# a NEGATIVE cached probe ages out so a revived relay is noticed; positive
+# results last the whole boot session
+PROBE_TTL = float(os.environ.get("BENCH_PROBE_TTL", 1800))
+
+
+def _probe_cache_path():
+    import tempfile
+
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = "noboot"
+    return os.path.join(tempfile.gettempdir(), f"mxnet_tpu_probe_{boot}.json")
+
+
+def read_probe_cache():
+    """Session-cached probe verdict, or None when absent/stale (r4 verdict
+    #8: a dead relay must cost ONE ~90s probe per session, not 5 min per
+    pytest invocation)."""
+    try:
+        with open(_probe_cache_path()) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or "alive" not in rec:
+        return None
+    if not rec["alive"]:
+        # a single-attempt verdict (pytest's retry-free probe) is weaker
+        # evidence than the full backoff ladder — expire it 3x sooner
+        ttl = PROBE_TTL if rec.get("attempts", 1) > 1 else PROBE_TTL / 3
+        if time.time() - rec.get("t", 0) > ttl:
+            return None
+    return rec
+
+
+def write_probe_cache(alive, detail="", attempts=1):
+    rec = {"alive": bool(alive), "t": time.time(), "attempts": int(attempts),
+           "detail": str(detail)[:300]}
+    path = _probe_cache_path()
+    tmp = f"{path}.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+    return rec
 RUN_TIMEOUT_TPU = float(os.environ.get("BENCH_RUN_TIMEOUT", 1500))
 RUN_TIMEOUT_CPU = float(os.environ.get("BENCH_RUN_TIMEOUT_CPU", 900))
 
 
 def _axon_env():
     env = dict(os.environ)
+    # an ambient JAX_PLATFORMS=cpu must not pin the probe/measurement
+    # child to the host backend — the default platform (axon where its
+    # sitecustomize is registered) is the point of this env
+    env.pop("JAX_PLATFORMS", None)
     if os.path.isdir("/root/.axon_site"):
         env["PYTHONPATH"] = "/root/.axon_site" + (
             ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
@@ -59,10 +114,20 @@ def probe_main():
                       "platforms": sorted({d.platform for d in devs})}))
 
 
-def _probe_tpu(history):
+def _probe_tpu(history, use_cache=False, attempts=None):
     """Run the probe subprocess with retries.  Returns True if a non-cpu
-    backend answered within the timeout."""
-    for attempt in range(len(PROBE_BACKOFFS) + 1):
+    backend answered within the timeout.  Every real probe refreshes the
+    session cache; use_cache=True short-circuits on a cached verdict
+    (tests/tools), while the driver bench always probes for real."""
+    if use_cache:
+        rec = read_probe_cache()
+        if rec is not None:
+            history.append({"cached": True, "alive": rec["alive"],
+                            "age_s": round(time.time() - rec.get("t", 0), 1)})
+            return rec["alive"]
+    if attempts is None:
+        attempts = len(PROBE_BACKOFFS) + 1
+    for attempt in range(attempts):
         t0 = time.time()
         try:
             out = subprocess.run(
@@ -77,11 +142,14 @@ def _probe_tpu(history):
                     info = {}
                 if info and "cpu" not in info.get("platforms", ["cpu"]):
                     history.append({"attempt": attempt, "ok": True, "s": dt})
+                    write_probe_cache(True, f"{info}", attempts=attempt + 1)
                     return True
                 # a healthy cpu-only answer is a definitive "no TPU here",
                 # not a transient relay failure — don't burn the backoffs
                 history.append({"attempt": attempt, "ok": False, "s": dt,
                                 "why": f"cpu-only backend {info}"})
+                write_probe_cache(False, f"cpu-only backend {info}",
+                                  attempts=attempts)
                 return False
             else:
                 tail = (out.stderr or out.stdout or "").strip().splitlines()
@@ -90,15 +158,18 @@ def _probe_tpu(history):
         except subprocess.TimeoutExpired:
             history.append({"attempt": attempt, "ok": False,
                             "s": round(time.time() - t0, 1), "why": "hang"})
-        if attempt < len(PROBE_BACKOFFS):
+        if attempt < attempts - 1 and attempt < len(PROBE_BACKOFFS):
             time.sleep(PROBE_BACKOFFS[attempt])
+    write_probe_cache(False, history[-1].get("why", "") if history else "",
+                      attempts=attempts)
     return False
 
 
-def _run_child(platform, timeout, history):
+def _run_child(platform, timeout, history, extra_env=None):
     """Run the measurement subprocess; return the parsed JSON dict or None."""
     t0 = time.time()
     env = _axon_env() if platform == "tpu" else _cpu_env()
+    env.update(extra_env or {})
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
@@ -150,7 +221,29 @@ def main():
         }
     else:
         result["probe_history"] = history
-    print(json.dumps(result))
+
+    # the hard-won primary number goes out IMMEDIATELY — if the driver's
+    # outer timeout kills us during the secondary below, the artifact
+    # still has the headline (the last parseable line is authoritative)
+    print(json.dumps(result), flush=True)
+
+    # Secondary metric merged into the SAME JSON object on a second line
+    # (r4 verdict #1: the driver only ever runs plain `python bench.py`,
+    # so the BERT tokens/sec must ride along with the ResNet headline or
+    # it never reaches a BENCH artifact).  Skipped when the caller pinned
+    # a model or when even the primary fell through to the error dict.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_SECONDARY", "1") != "0"
+            and "error" not in result):
+        platform = result.get("platform", "cpu")
+        sec_timeout = float(os.environ.get(
+            "BENCH_SECONDARY_TIMEOUT", 600 if platform == "tpu" else 420))
+        sec = _run_child(platform, sec_timeout, history,
+                         extra_env={"BENCH_MODEL": "bert"})
+        if sec is not None:
+            sec.pop("probe_history", None)
+            result["secondary"] = sec
+            print(json.dumps(result), flush=True)
 
 
 # ---------------------------------------------------------------------------

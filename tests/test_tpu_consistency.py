@@ -70,18 +70,14 @@ def _tpu_results():
         env["PYTHONPATH"] = "/root/.axon_site"
         env["JAX_PLATFORMS"] = "axon"
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # cheap liveness probe first: a hung tunnel should cost ~90s, not the
-    # full compile budget
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(float(jax.numpy.ones(1).sum()))"],
-            capture_output=True, text=True, timeout=_PROBE_TIMEOUT, env=env,
-            cwd=root)
-        if probe.returncode != 0:
-            pytest.skip(f"TPU probe failed: {probe.stderr[-200:]}")
-    except subprocess.TimeoutExpired:
-        pytest.skip("TPU unreachable (probe timed out)")
+    # liveness probe, session-cached (r4 verdict #8): the first pytest run
+    # of a session pays ~90s against a dead relay, every later run reads
+    # the cached verdict (negatives age out per bench.PROBE_TTL)
+    sys.path.insert(0, root)
+    import bench as _bench
+
+    if not _bench._probe_tpu([], use_cache=True, attempts=1):
+        pytest.skip("TPU unreachable (session-cached probe verdict)")
     try:
         proc = subprocess.run([sys.executable, "-c", _CHILD],
                               capture_output=True, text=True,

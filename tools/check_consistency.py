@@ -49,7 +49,9 @@ def _axon_env():
 
 
 def _probe():
-    return _bench._probe_tpu([])
+    # session cache: if bench/pytest already paid for a probe this boot,
+    # reuse the verdict instead of burning ~5 min on a dead relay again
+    return _bench._probe_tpu([], use_cache=True)
 
 
 def tpu_child(case_ids, result_path):
