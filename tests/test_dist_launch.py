@@ -101,3 +101,52 @@ def test_launch_cli_rejects_missing_command():
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"), "-n", "2"],
         capture_output=True, text=True)
     assert res.returncode != 0
+
+
+def test_dist_tp_combo_two_workers_parity():
+    """2 processes x 2 devices each, global mesh dp2(hosts)xtp2(local) —
+    the v5p pod shape in miniature (r4 verdict #6).  The multi-process
+    run's loss trajectory must match the SAME config on a single-process
+    dp2xtp2 mesh."""
+    res = _launch(2, "tests/dist/dist_tp_worker.py", timeout=300)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("dist tp OK") == 2, res.stdout
+    import re
+
+    worker_losses = {
+        tuple(float(x) for x in m.group(1).split(","))
+        for m in re.finditer(r"dist tp OK losses=([\d.,-]+)", res.stdout)
+    }
+    assert len(worker_losses) == 1, f"workers diverged: {worker_losses}"
+
+    # single-process reference on this process's virtual devices
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.models import bert_small
+    from mxnet_tpu.models.bert import bert_sharding_rules
+    from mxnet_tpu.parallel import DataParallelStep, make_mesh
+
+    mesh = make_mesh(tp=2, devices=jax.devices("cpu")[:4])
+    mx.random.seed(0)
+    net = bert_small()
+    net.initialize(mx.init.Normal(0.02))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(logits, labels):
+        return loss_fn(logits.reshape(-1, logits.shape[-1]),
+                       labels.reshape(-1))
+
+    step = DataParallelStep(net, mlm_loss, mesh=mesh, optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-3},
+                            rules=bert_sharding_rules())
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 512, (8, 16)).astype(np.int32)
+    labels = tokens.astype(np.float32)
+    ref = [float(np.asarray(step.step(nd.array(tokens, dtype="int32"),
+                                      nd.array(labels))))
+           for _ in range(3)]
+    np.testing.assert_allclose(list(worker_losses)[0], ref, rtol=1e-4,
+                               err_msg="multi-process vs single-process")
