@@ -253,6 +253,46 @@ def test_dataloader():
     assert seen == 20
 
 
+class _SquareTransformDataset:
+    """Module-level (picklable) dataset with a GIL-bound python transform —
+    the workload DataLoader process workers exist for."""
+
+    def __init__(self, n=24, dim=9000):
+        self._rng_data = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+
+    def __len__(self):
+        return len(self._rng_data)
+
+    def __getitem__(self, i):
+        row = self._rng_data[i]
+        # pure-python loop: holds the GIL, so only processes parallelize it
+        s = 0.0
+        for k in range(64):
+            s += (k % 7) * 0.5
+        return row * 2.0 + s, np.float32(i)
+
+
+def test_dataloader_process_workers_shm():
+    """num_workers>0 default path: spawn process pool + shared-memory
+    transport; order and values must match the serial loader exactly
+    (reference: gluon/data/dataloader.py multiprocessing workers ~L400)."""
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareTransformDataset()
+    serial = DataLoader(ds, batch_size=5, last_batch="keep")
+    workers = DataLoader(ds, batch_size=5, last_batch="keep", num_workers=2)
+    got = list(workers)
+    want = list(serial)
+    assert len(got) == len(want) == len(workers)
+    for (gd, gl), (wd, wl) in zip(got, want):
+        # rows are >= _SHM_MIN_BYTES -> the shm path carried them
+        np.testing.assert_allclose(gd.asnumpy(), wd.asnumpy())
+        np.testing.assert_allclose(gl.asnumpy(), wl.asnumpy())
+    # pool is persistent across iterations
+    again = list(workers)
+    assert len(again) == len(want)
+
+
 def test_loss_functions():
     pred = nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
     label = nd.array([2, 0])
