@@ -53,8 +53,11 @@ class StackedTransformerEncoder(HybridBlock):
         L, U, H = num_layers, units, hidden_size
         with self.name_scope():
             g = self.params.get
-            self.qkv_weight = g("qkv_weight", shape=(L, 3 * U, U))
-            self.qkv_bias = g("qkv_bias", shape=(L, 3 * U))
+            # qkv as (L, 3, U, U) — one PartitionSpec tp-splits the head
+            # dim of q, k and v alike (a fused (3U, U) layout would chunk
+            # contiguous rows across the q/k/v thirds)
+            self.qkv_weight = g("qkv_weight", shape=(L, 3, U, U))
+            self.qkv_bias = g("qkv_bias", shape=(L, 3, U))
             self.proj_weight = g("proj_weight", shape=(L, U, U))
             self.proj_bias = g("proj_bias", shape=(L, U))
             self.ffn1_weight = g("ffn1_weight", shape=(L, H, U))
@@ -67,8 +70,16 @@ class StackedTransformerEncoder(HybridBlock):
             self.ln2_beta = g("ln2_beta", shape=(L, U), init="zeros")
 
     # -- pure jnp layer body shared by scan and pipeline paths ---------
-    def _layer(self, p, x):
-        nh, hd = self._heads, self._head_dim
+    def _layer(self, p, x, tp_axis=None):
+        """One post-LN encoder layer.
+
+        tp_axis: set ('tp') ONLY inside the pipeline's shard_map when the
+        mesh has tp>1 — weights arrive as Megatron column/row shards and
+        the two row-parallel matmuls psum their partial outputs here.
+        Outside shard_map (the lax.scan path) tp_axis stays None and
+        GSPMD inserts the collectives from the parameter shardings.
+        """
+        hd = self._head_dim
 
         def ln(y, gamma, beta):
             mu = y.mean(-1, keepdims=True)
@@ -76,21 +87,30 @@ class StackedTransformerEncoder(HybridBlock):
             return (y - mu) / jnp.sqrt(var + 1e-5) * gamma + beta
 
         b, t, u = x.shape
-        qkv = x @ p["qkv_weight"].T + p["qkv_bias"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qw, qb = p["qkv_weight"], p["qkv_bias"]  # (3, Uloc, U), (3, Uloc)
+        q = x @ qw[0].T + qb[0]
+        k = x @ qw[1].T + qb[1]
+        v = x @ qw[2].T + qb[2]
+        nh_loc = q.shape[-1] // hd  # heads this shard owns (nh/tp)
 
-        def heads(y):  # (B, T, U) -> (B, nh, T, hd)
-            return y.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        def heads(y):  # (B, T, Uloc) -> (B, nh_loc, T, hd)
+            return y.reshape(b, t, nh_loc, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
         scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd)
         attn = jax.nn.softmax(scores, axis=-1)
-        out = (attn @ v).transpose(0, 2, 1, 3).reshape(b, t, u)
-        out = out @ p["proj_weight"].T + p["proj_bias"]
+        out = (attn @ v).transpose(0, 2, 1, 3).reshape(b, t, -1)
+        out = out @ p["proj_weight"].T  # row-parallel: partial (B, T, U)
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        out = out + p["proj_bias"]
         x = ln(x + out, p["ln1_gamma"], p["ln1_beta"])
-        h = x @ p["ffn1_weight"].T + p["ffn1_bias"]
+        h = x @ p["ffn1_weight"].T + p["ffn1_bias"]  # column-parallel
         h = jax.nn.gelu(h, approximate=False)
-        h = h @ p["ffn2_weight"].T + p["ffn2_bias"]
+        h = h @ p["ffn2_weight"].T  # row-parallel: partial (B, T, U)
+        if tp_axis is not None:
+            h = jax.lax.psum(h, tp_axis)
+        h = h + p["ffn2_bias"]
         return ln(x + h, p["ln2_gamma"], p["ln2_beta"])
 
     def hybrid_forward(self, F, x, **params):
@@ -123,12 +143,24 @@ class StackedTransformerEncoder(HybridBlock):
                     f"per-microbatch batch {bsz // m} not divisible by the "
                     f"data-parallel extent {dp_total} ({batch_axes}); lower "
                     f"pp_microbatches or raise the batch size")
+            # tensor parallelism inside the stage: Megatron shards over
+            # 'tp' (activations replicated across tp; _layer psums the
+            # row-parallel outputs)
+            tp = mesh.shape.get("tp", 1) > 1
+            if tp and self._heads % mesh.shape["tp"]:
+                raise MXNetError(
+                    f"{self._heads} heads not divisible by "
+                    f"tp={mesh.shape['tp']}")
+            layer_fn = ((lambda pl, c: self._layer(pl, c, tp_axis="tp"))
+                        if tp else self._layer)
             # strided microbatches (rows i::m): a dp-sharded batch dim
             # stays dp-sharded per microbatch with zero data movement
             xm = xa.reshape(bsz // m, m, *xa.shape[1:]).transpose(
                 1, 0, *range(2, xa.ndim + 1))
-            ym = pipeline_apply(mesh, self._layer, stacked, xm,
-                                batch_axes=batch_axes)
+            ym = pipeline_apply(mesh, layer_fn, stacked, xm,
+                                batch_axes=batch_axes,
+                                param_specs=_pp_param_specs(
+                                    stacked, tp=tp))
             out = ym.transpose(1, 0, *range(2, ym.ndim)).reshape(xa.shape)
             if not isinstance(out, jax.core.Tracer):
                 # eager call: bring the mesh-sharded result back to the
@@ -171,12 +203,47 @@ class BERTForMLMPipelined(HybridBlock):
         return self.decoder(h)
 
 
+# Megatron layout of the stacked encoder leaves: which non-layer dim (if
+# any) carries the 'tp' shard.  qkv/ffn1 are column-parallel (output dim),
+# proj/ffn2 row-parallel (input dim); ln/bias-after-psum replicate.
+_TP_DIM = {
+    "qkv_weight": 2, "qkv_bias": 2,      # (L, 3, U, U) / (L, 3, U)
+    "ffn1_weight": 1, "ffn1_bias": 1,    # (L, H, U) / (L, H)
+    "proj_weight": 2,                    # (L, U, U) input dim
+    "ffn2_weight": 2,                    # (L, U, H) input dim
+}
+
+
+def _pp_param_specs(stacked, tp: bool):
+    """PartitionSpec tree for pipeline_apply: layer dim over 'pp', plus
+    the Megatron 'tp' dim per leaf when tp is active."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = {}
+    for name, arr in stacked.items():
+        short = name.rsplit("_", 2)
+        key = "_".join(short[-2:])
+        dims = [None] * arr.ndim
+        dims[0] = "pp"
+        if tp and key in _TP_DIM:
+            dims[_TP_DIM[key]] = "tp"
+        specs[name] = P(*dims)
+    return specs
+
+
 def bert_pp_sharding_rules() -> ShardingRules:
-    """Stacked encoder params shard their LAYER dim over 'pp'; embeddings
-    and the MLM head stay replicated (they run on every rank)."""
-    return ShardingRules([
-        (r".*enc_stack_.*", ("pp",)),
-    ])
+    """Stacked encoder params shard their LAYER dim over 'pp' and (where
+    the Megatron layout allows) a weight dim over 'tp'; embeddings and
+    the MLM head stay replicated (they run on every rank).  Derived from
+    the same _TP_DIM table as _pp_param_specs, so the GSPMD shardings
+    MATCH the shard_map specs and entering the pipeline moves no data."""
+    rules = [
+        (rf".*enc_stack_{key}$",
+         ("pp",) + (None,) * (dim - 1) + ("tp",))
+        for key, dim in _TP_DIM.items()
+    ]
+    rules.append((r".*enc_stack_.*", ("pp",)))
+    return ShardingRules(rules)
 
 
 def bert_pp_small(vocab_size=512, units=64, hidden_size=128, num_layers=4,

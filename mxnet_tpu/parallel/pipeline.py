@@ -27,7 +27,7 @@ __all__ = ["pipeline_apply"]
 
 
 def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
-                   axis: str = "pp", batch_axes=()):
+                   axis: str = "pp", batch_axes=(), param_specs=None):
     """Run L stacked uniform layers as a pp-stage pipeline.
 
     mesh: jax Mesh with a size-S `axis`; L must be divisible by S.
@@ -37,6 +37,11 @@ def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
     x_micro: (M, b, ...) microbatches; dim 1 (the batch dim) may be
         sharded over `batch_axes` (e.g. ("dp",)) — dp×pp composition
         without the shard_map forcing a batch all-gather.
+    param_specs: optional pytree of PartitionSpec matching stacked_params
+        for tensor parallelism INSIDE the stage: leaves may shard extra
+        dims over 'tp' (Megatron column/row splits), in which case `fn`
+        runs on local shards and must psum its row-parallel outputs over
+        'tp' itself.  Default: every leaf P(axis) (layer dim only).
     Returns (M, b, ...) outputs, same sharding (valid on every pp rank).
 
     Schedule: M + S - 1 clock ticks; at tick t, stage r processes
@@ -98,7 +103,8 @@ def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
         return jax.lax.psum(
             jnp.where(r == S - 1, buf, jnp.zeros_like(buf)), axis)
 
-    spec_p = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    spec_p = (param_specs if param_specs is not None else
+              jax.tree_util.tree_map(lambda _: P(axis), stacked_params))
     spec_x = P(None, batch_axes if len(batch_axes) > 1 else
                (batch_axes[0] if batch_axes else None))
     if not any(isinstance(l, jax.core.Tracer)
@@ -109,8 +115,8 @@ def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,
         from jax.sharding import NamedSharding
 
         stacked_params = jax.tree_util.tree_map(
-            lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
-            stacked_params)
+            lambda l, sp: jax.device_put(l, NamedSharding(mesh, sp)),
+            stacked_params, spec_p)
         x_micro = jax.device_put(x_micro, NamedSharding(mesh, spec_x))
     fn_sm = jax.shard_map(ranked, mesh=mesh, in_specs=(spec_p, spec_x),
                           out_specs=spec_x, check_vma=False)

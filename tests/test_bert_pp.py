@@ -92,3 +92,58 @@ def test_stacked_encoder_eager_scan_matches_pipeline_off_mesh():
     with pipeline_parallel_scope(mesh, (), microbatches=2):
         got = net(tb).asnumpy()
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_bert_fused_warmup_decay_schedule():
+    """r4 verdict #3 done-criterion: BERT trains through the fused path
+    with a warmup+decay schedule, and the schedule visibly changes the
+    updates (warmup ramps lr up, decay brings it down) with no retrace."""
+    from mxnet_tpu.optimizer.lr_scheduler import PolyScheduler
+
+    mx.random.seed(5)
+    net = bert_pp_small(num_layers=2)
+    net.initialize(mx.init.Normal(0.02))
+    sched = PolyScheduler(max_update=8, base_lr=1e-3, pwr=1, final_lr=0.0,
+                          warmup_steps=3, warmup_begin_lr=0.0)
+    step = DataParallelStep(net, _mlm_loss(), mesh=local_mesh(),
+                            optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-3,
+                                              "lr_scheduler": sched},
+                            clip_global_norm=1.0)
+    tokens, labels = _data(B=8)
+    lrs, norms = [], []
+    prev = None
+    for _ in range(6):
+        lrs.append(step.learning_rate)  # lr the upcoming step will use
+        step.step(nd.array(tokens, dtype="int32"), nd.array(labels))
+        cur = {n: np.asarray(v) for n, v in step.params.items()}
+        if prev is not None:
+            delta = np.sqrt(sum(
+                float(((cur[n] - prev[n]) ** 2).sum()) for n in cur
+                if "embed" not in n))
+            norms.append(delta)
+        prev = cur
+    # warmup (num_update is 1-based): lr ramps base/3 -> 2base/3 -> base,
+    # then poly-decays
+    assert lrs[0] == pytest.approx(1e-3 / 3, rel=1e-5)
+    assert lrs[0] < lrs[1] < lrs[2], lrs
+    assert lrs[2] == pytest.approx(1e-3, rel=1e-6)
+    assert lrs[3] < lrs[2] and lrs[4] < lrs[3], lrs
+    assert all(n > 0 for n in norms)  # every lr>0 step moved the params
+
+
+def test_pp_tp_dp_3d_parity():
+    """Full 3D parallelism in ONE program: dp2 x pp2 x tp2 over 8 devices
+    (GPipe schedule over pp, Megatron column/row shards + psum inside the
+    stage, dp-sharded batch) matches plain dp8 training exactly."""
+    import jax
+
+    devices = jax.devices("cpu")[:8]
+    d3_losses, step = _run(make_mesh(pp=2, tp=2, devices=devices),
+                           pp_microbatches=2)
+    dp_losses, _ = _run(make_mesh(devices=devices), pp_microbatches=2)
+    np.testing.assert_allclose(d3_losses, dp_losses, rtol=2e-4,
+                               err_msg=f"{d3_losses} vs {dp_losses}")
+    qkv = [n for n in step.params if n.endswith("qkv_weight")]
+    spec = str(step.params[qkv[0]].sharding.spec)
+    assert "pp" in spec and "tp" in spec, spec
