@@ -16,6 +16,12 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: repeat suite runs on this VM skip XLA
+# compilation for the model-sized programs (the suite is compile-heavy)
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("MXNET_TEST_JAX_CACHE",
+                                 "/tmp/mxnet_tpu_test_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -69,6 +75,11 @@ def pytest_collection_modifyitems(config, items):
         name = item.nodeid.split("/")[-1]
         if name.startswith("test_dist_launch.py::"):
             item.add_marker(pytest.mark.dist)
+        # n=3 variants re-cover the n=2 path with non-power-of-two ranks:
+        # valuable, but redundant for the default tier (r4 verdict #9)
+        if base in ("test_dist_launch.py::test_dist_sync_kvstore_three_workers",
+                    "test_dist_launch.py::test_dist_sync_training_three_workers"):
+            item.add_marker(pytest.mark.slow)
         if (name.startswith("test_op_sweep.py::test_gradient")
                 or name.startswith("test_op_sweep.py::test_bf16_backward")):
             item.add_marker(pytest.mark.slow)

@@ -90,6 +90,9 @@ def test_faster_rcnn_train_step_decreases_loss():
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-3})
     x, gt, im_info = _batch()
+    loss_block(x, gt, im_info)  # resolve deferred shapes (incl. the roi
+    # head's dense layers), then compile the 4-loss graph once
+    loss_block.hybridize()
     losses = []
     for _ in range(12):
         with autograd.record():

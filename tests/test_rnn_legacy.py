@@ -251,7 +251,7 @@ def test_bucketing_module_with_bucket_iter_converges():
             ["data"], ["softmax_label"]
 
     mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=it.default_bucket_key)
-    mod.fit(it, num_epoch=3,
+    mod.fit(it, num_epoch=2,
             eval_metric=mx.metric.Perplexity(ignore_label=None),
             optimizer="adam", optimizer_params={"learning_rate": 0.05})
     score = mod.score(it, mx.metric.Perplexity(ignore_label=None))

@@ -31,6 +31,8 @@ def test_ssd_forward_shapes():
 
 def test_ssd_train_step_decreases_loss():
     net = _net(num_classes=1)
+    net.hybridize()  # compiled forward: the 12-step loop was the
+    # suite's #3 cost at 77s eager
     loss_block = SSDTrainLoss()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-3})

@@ -131,6 +131,8 @@ def test_seq2seq_learns_reverse_and_beam_decodes():
     from mxnet_tpu import gluon
 
     net = _tiny_model()
+    net.hybridize()  # one CachedOp per sub-block: the 80-step memorize
+    # loop runs compiled instead of eagerly re-recording every op
     rng = np.random.RandomState(2)
     src, tgt_in, tgt_out = _reverse_batch(rng, 8)
 
