@@ -4,6 +4,8 @@ bert pretraining scripts the reference docs point at; BASELINE target 2).
 Single chip:   python examples/bert_pretrain.py --steps 20
 Virtual mesh:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
                python examples/bert_pretrain.py --dp 4 --tp 2 --model small
+3D (dp/pp/tp): ... bert_pretrain.py --dp 2 --pp 2 --tp 2 --model small
+               (pipeline-parallel stacked encoder, models/bert_pp.py)
 """
 import argparse
 import sys
@@ -28,6 +30,9 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages (uses the stacked pp encoder)")
+    ap.add_argument("--pp-microbatches", type=int, default=2)
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     ap.add_argument("--device", default="auto", choices=["auto", "cpu"])
@@ -41,17 +46,26 @@ def main():
         mx.context.pin_platform("cpu")
 
     mx.random.seed(0)
-    n_dev = args.dp * args.tp
+    n_dev = args.dp * args.tp * args.pp
     devices = jax.devices()[:n_dev]
     if len(devices) < n_dev:
         raise SystemExit(f"need {n_dev} devices, have {len(devices)}")
-    mesh = make_mesh(tp=args.tp, devices=devices)
+    mesh = make_mesh(tp=args.tp, pp=args.pp, devices=devices)
 
-    if args.model == "base":
-        net = bert_base()
+    if args.pp > 1:
+        # pipeline path: the stacked-parameter encoder (models/bert_pp.py)
+        from mxnet_tpu.models import bert_pp_small
+        from mxnet_tpu.models.bert_pp import (BERTForMLMPipelined,
+                                              bert_pp_sharding_rules)
+
+        net = (BERTForMLMPipelined() if args.model == "base"
+               else bert_pp_small())
+        rules = bert_pp_sharding_rules()
     else:
-        net = bert_small()
-        args.seq_len = min(args.seq_len, 64)  # bert_small max_length
+        net = bert_base() if args.model == "base" else bert_small()
+        rules = bert_sharding_rules()
+    if args.model != "base":
+        args.seq_len = min(args.seq_len, 64)  # small-config max_length
     net.initialize(mx.init.Normal(0.02))
     if args.dtype == "bfloat16":
         net.cast("bfloat16")
@@ -63,7 +77,8 @@ def main():
 
     step = DataParallelStep(net, mlm_loss, mesh=mesh, optimizer="adam",
                             optimizer_params={"learning_rate": 1e-4},
-                            rules=bert_sharding_rules())
+                            rules=rules,
+                            pp_microbatches=args.pp_microbatches)
     V = 30522 if args.model == "base" else 512
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, V, (args.batch_size, args.seq_len)).astype(
@@ -81,7 +96,8 @@ def main():
             toks = (i + 1) * args.batch_size * args.seq_len
             print(f"step {i}: loss={v:.4f}  {toks / dt:.0f} tok/s")
     v = float(np.asarray(loss))
-    print(f"final mlm loss {v:.4f} on mesh dp{args.dp}xtp{args.tp}")
+    print(f"final mlm loss {v:.4f} on mesh "
+          f"dp{args.dp}xpp{args.pp}xtp{args.tp}")
     assert np.isfinite(v)
 
 

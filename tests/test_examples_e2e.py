@@ -77,3 +77,17 @@ def test_train_detection_e2e():
         cwd=_REPO, capture_output=True, text=True, timeout=420)
     assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
     assert "faster_rcnn: loss" in res.stdout, res.stdout[-500:]
+
+
+def test_bert_pretrain_3d_e2e():
+    """3D-parallel (dp2 x pp2 x tp2) BERT pretrain example on the virtual
+    mesh (slow tier)."""
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "bert_pretrain.py"),
+         "--dp", "2", "--pp", "2", "--tp", "2", "--model", "small",
+         "--steps", "3", "--batch-size", "8"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420, env=env)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "dp2xpp2xtp2" in res.stdout, res.stdout[-500:]

@@ -141,8 +141,8 @@ def test_seq2seq_learns_reverse_and_beam_decodes():
     tb = nd.array(tgt_in, dtype="int32")
     lb = nd.array(tgt_out.astype(np.float32))
     trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 3e-3})
-    for i in range(80):
+                            {"learning_rate": 5e-3})
+    for i in range(48):
         with autograd.record():
             logits = net(sb, tb)
             loss = label_smoothed_ce(logits, lb, smoothing=0.0)
