@@ -1,0 +1,78 @@
+"""tools/_runner.py: the shared on-chip task runner's success/persist
+contract (used by tools/relay_watch.py and tools/on_chip_suite.py).
+
+A CPU-fallback measurement must never be recorded as an on-chip artifact
+(r4 weak #1: the only BENCH artifact captured that round was a silent CPU
+fallback), and a skipped consistency sweep must not count as done."""
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import _runner  # noqa: E402
+
+
+def _emit(payload):
+    return [sys.executable, "-c",
+            f"import json; print(json.dumps({payload!r}))"]
+
+
+def _art(name):
+    return os.path.join(_runner.ART, f"{name}.json")
+
+
+def test_cpu_metric_not_persisted():
+    ok, rec = _runner.run_task(
+        "rt_cpu", _emit({"metric": "m", "value": 1, "platform": "cpu"}),
+        {}, 60)
+    assert ok is False and rec["rc"] == 0
+    assert not os.path.exists(_art("rt_cpu"))
+
+
+def test_tpu_metric_persisted():
+    ok, _ = _runner.run_task(
+        "rt_tpu", _emit({"metric": "m", "value": 2, "platform": "tpu"}),
+        {}, 60)
+    try:
+        assert ok is True
+        with open(_art("rt_tpu")) as f:
+            assert json.load(f)["value"] == 2
+    finally:
+        if os.path.exists(_art("rt_tpu")):
+            os.unlink(_art("rt_tpu"))
+
+
+def test_device_key_guard():
+    # bench_step.py tags "device" instead of "platform"
+    ok, _ = _runner.run_task(
+        "rt_dev", _emit({"metric": "m", "value": 3, "device": "cpu"}), {}, 60)
+    assert ok is False
+    assert not os.path.exists(_art("rt_dev"))
+
+
+def test_skipped_sweep_fails():
+    ok, _ = _runner.run_task("rt_skip", _emit({"skipped": True}), {}, 60)
+    assert ok is False
+
+
+def test_compared_sweep_passes():
+    ok, _ = _runner.run_task(
+        "rt_sweep", _emit({"skipped": False, "cases_compared": 10}), {}, 60)
+    assert ok is True
+
+
+def test_nonzero_rc_fails():
+    ok, rec = _runner.run_task(
+        "rt_rc", [sys.executable, "-c", "import sys; sys.exit(3)"], {}, 60)
+    assert ok is False and rec["rc"] == 3
+
+
+def test_validator_gates_success():
+    ok, _ = _runner.run_task(
+        "rt_val", [sys.executable, "-c", "print('no json')"], {}, 60,
+        validator=lambda: False)
+    assert ok is False

@@ -1,0 +1,150 @@
+"""Shared subprocess runner for the on-chip measurement tools.
+
+One implementation of the run-with-timeout + artifact-persist contract,
+used by both tools/on_chip_suite.py (one-shot batch) and
+tools/relay_watch.py (probe loop) so the two cannot drift:
+
+- every task runs under bench._axon_env() (PYTHONPATH=/root/.axon_site +
+  JAX_PLATFORMS=axon when the relay site exists) — tools that don't
+  rebuild the env themselves would otherwise silently fall back to CPU;
+- a metric JSON line on stdout is persisted to docs/artifacts/<name>.json
+  ONLY when its measured platform/device is "tpu" — a CPU fallback must
+  never clobber a committed on-chip artifact;
+- a consistency-style report line ({"skipped": ...}) only counts as
+  success when the sweep really compared cases.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(_REPO, "docs", "artifacts")
+_PY = sys.executable
+
+sys.path.insert(0, _REPO)
+import bench as _bench  # noqa: E402
+
+# Canonical on-chip task table — THE one list both tools consume, in
+# value order for a short relay window (headline first, then the
+# MFU-decisive profile, then the never-measured metrics, then ablations
+# and the long consistency sweep).  Artifact names = task names, so a
+# measurement captured by either tool is visible to both.
+TASKS = [
+    # (name, argv, extra_env, timeout_s)
+    ("bench_resnet_bs256_nhwc",
+     [_PY, "bench.py"], {"BENCH_SECONDARY": "0"}, 1500),
+    ("tpu_profile_hlo",
+     [_PY, "tools/dump_hlo.py", "--platform", "tpu", "--batch", "256",
+      "--profile-steps", "5"], {}, 1500),
+    ("bench_bert",
+     [_PY, "bench.py"], {"BENCH_MODEL": "bert", "BENCH_SECONDARY": "0"},
+     1200),
+    ("bench_bert_nofusion",
+     [_PY, "bench.py"],
+     {"BENCH_MODEL": "bert", "BENCH_SECONDARY": "0",
+      "MXNET_USE_FUSION": "0"}, 1200),
+    ("bench_resnet_bs128_nhwc",
+     [_PY, "bench.py"], {"BENCH_BATCH": "128", "BENCH_SECONDARY": "0"},
+     1200),
+    ("bench_resnet_bs256_nchw",
+     [_PY, "bench.py"], {"BENCH_LAYOUT": "NCHW", "BENCH_SECONDARY": "0"},
+     1200),
+    ("bench_step_tpu",
+     [_PY, "tools/bench_step.py", "--device", "tpu"], {}, 900),
+    ("bench_e2e_tpu",
+     [_PY, "tools/bench_e2e.py", "--tpu", "--size", "256", "--crop", "224",
+      "--batch-size", "256", "--model", "resnet50_v1b", "--dtype",
+      "bfloat16", "--num-images", "2048", "--num-classes", "1000"], {},
+     1500),
+    ("bench_transformer",
+     [_PY, "bench.py"],
+     {"BENCH_MODEL": "transformer", "BENCH_SECONDARY": "0"}, 1200),
+    ("consistency",
+     [_PY, "tools/check_consistency.py"], {}, 1800),
+]
+
+# task -> other task whose success makes it unnecessary (the nofusion
+# BERT run is only a fallback for a Pallas failure on the relay)
+SKIP_IF = {"bench_bert_nofusion": "bench_bert"}
+
+
+def _profile_ok():
+    """dump_hlo exits 0 even when lowering failed — success requires the
+    actual optimized (or at least stablehlo) module in the artifact."""
+    path = os.path.join(ART, "resnet50_step_nhwc_bs256.tpu.hlo.txt")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return False
+    return "### optimized" in text or "### stablehlo" in text
+
+
+VALIDATORS = {"tpu_profile_hlo": _profile_ok}
+
+
+def artifact_done(name):
+    """True if docs/artifacts/<name>.json already holds an on-chip metric
+    (so neither tool re-burns a relay window re-measuring it)."""
+    try:
+        with open(os.path.join(ART, f"{name}.json")) as f:
+            j = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return j.get("platform", j.get("device")) == "tpu"
+
+
+def run_task(name, argv, extra_env=None, timeout=1800, validator=None):
+    """Run `argv` in a subprocess; return (ok, record).
+
+    ok = exit 0, AND the metric line (if any) was measured on TPU, AND the
+    report line (if any) wasn't a skipped/empty sweep, AND `validator()`
+    (if given) confirms the produced artifact is real.
+    """
+    env = _bench._axon_env()
+    env.update(extra_env or {})
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, cwd=_REPO, env=env, capture_output=True,
+                           text=True, timeout=timeout)
+        out, rc = p.stdout or "", p.returncode
+        err = (p.stderr or "")[-1500:]
+    except subprocess.TimeoutExpired as te:
+        # keep whatever the child printed: bench.py emits its primary JSON
+        # line as soon as it exists
+        out = te.stdout if isinstance(te.stdout, str) else (
+            te.stdout.decode() if te.stdout else "")
+        rc, err = -1, f"TIMEOUT after {timeout}s"
+    dt = round(time.time() - t0, 1)
+    rec = {"task": name, "rc": rc, "s": dt,
+           "stdout_tail": out.strip().splitlines()[-4:] if out else [],
+           "stderr_tail": err.strip().splitlines()[-2:] if err else []}
+
+    ok = rc == 0
+    os.makedirs(ART, exist_ok=True)
+    for line in reversed(out.strip().splitlines() if out else []):
+        try:
+            j = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if not isinstance(j, dict):
+            continue
+        if "metric" in j:
+            # bench.py/bench_e2e.py tag "platform"; bench_step.py "device"
+            if j.get("platform", j.get("device")) != "tpu":
+                ok = False  # CPU fallback: don't persist, retry later
+            else:
+                with open(os.path.join(ART, f"{name}.json"), "w") as f:
+                    json.dump(j, f, indent=1)
+            break
+        if "skipped" in j:
+            # check_consistency exits 0 on a skipped sweep — only a
+            # really-compared sweep counts as done
+            if j.get("skipped") or not j.get("cases_compared"):
+                ok = False
+            break
+    if ok and validator is not None:
+        ok = validator()
+    return ok, rec

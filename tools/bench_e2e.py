@@ -120,7 +120,9 @@ def main():
         "value": round(n / total, 1), "unit": "images/sec",
         "input_stall_pct": round(100.0 * fetch_s / total, 1),
         "final_loss": round(final, 4),
-        "platform": "tpu" if args.tpu else "cpu",
+        # measured backend, not the requested flag (relay_watch keys off it)
+        "platform": ("cpu" if jax.devices()[0].platform == "cpu" else "tpu"),
+        "requested": "tpu" if args.tpu else "cpu",
         "native_io": native_mod.available(),
         "model": args.model, "batch": args.batch_size, "crop": args.crop,
         "threads": args.threads,

@@ -67,11 +67,14 @@ def main():
         trainer.step(args.batch)
         return loss
 
-    jax.block_until_ready(eager_step()._data)  # warmup/compile
+    # host-materialize the loss rather than block_until_ready: the latter
+    # does NOT block through the axon relay (see bench.py _timed_steps);
+    # steps chain through the updated params, so the final read times all
+    float(np.asarray(eager_step()._data).sum())  # warmup/compile
     t0 = time.perf_counter()
     for _ in range(args.steps):
         loss = eager_step()
-    jax.block_until_ready(loss._data)
+    float(np.asarray(loss._data).sum())
     eager_dt = (time.perf_counter() - t0) / args.steps
 
     # --- fused step -------------------------------------------------------
@@ -80,20 +83,25 @@ def main():
         net2, loss_fn, mesh=local_mesh(devices=[mx.current_context().jax_device]),
         optimizer="sgd", optimizer_params={"learning_rate": 0.05,
                                            "momentum": 0.9})
-    jax.block_until_ready(step.step(nd.array(x), nd.array(y)))  # compile
+    float(np.asarray(step.step(nd.array(x), nd.array(y))).sum())  # compile
     t0 = time.perf_counter()
     for _ in range(args.steps):
         loss = step.step(nd.array(x), nd.array(y))
-    jax.block_until_ready(loss)
+    float(np.asarray(loss).sum())
     fused_dt = (time.perf_counter() - t0) / args.steps
 
+    # report the MEASURED backend, not the requested flag — without the
+    # axon env a --device tpu run silently lands on CPU and must not be
+    # recorded as an on-chip number (tools/relay_watch.py keys off this)
+    measured = jax.devices()[0].platform
     print(json.dumps({
         "metric": "fused_vs_eager_step_speedup",
         "eager_ms": round(eager_dt * 1e3, 2),
         "fused_ms": round(fused_dt * 1e3, 2),
         "value": round(eager_dt / fused_dt, 2),
         "unit": "x",
-        "device": args.device, "batch": args.batch}))
+        "device": "cpu" if measured == "cpu" else "tpu",
+        "requested": args.device, "batch": args.batch}))
 
 
 if __name__ == "__main__":

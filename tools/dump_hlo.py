@@ -48,6 +48,13 @@ def main():
         platform = "cpu"
     else:
         platform = "tpu"
+        # refuse to write a .tpu artifact from a silent CPU fallback (no
+        # axon env → JAX quietly uses the host backend)
+        measured = jax.devices()[0].platform
+        if measured == "cpu":
+            print(f"ERROR: requested tpu but measured backend is cpu",
+                  file=sys.stderr)
+            return 1
 
     import numpy as np
 
@@ -133,4 +140,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -1,68 +1,33 @@
 #!/usr/bin/env python
-"""One-shot on-chip measurement suite (r4).
+"""One-shot on-chip measurement suite (r4; r5: shares tools/_runner.TASKS).
 
-Runs every TPU-dependent measurement the r3 verdict asked for, the moment
-the relay answers, each step in a subprocess with a hard timeout so one
-hang cannot kill the batch.  Artifacts land in docs/artifacts/ and a
-combined log in docs/artifacts/on_chip_suite.log.
+NOTE (r5): when the relay is only intermittently alive, prefer
+`tools/relay_watch.py` — it probes in a loop, runs the same canonical
+task list, and re-probes between steps.  This suite remains the one-shot
+batch for a relay that is actually up.
+
+Runs every TPU-dependent measurement the r3 verdict asked for — the
+canonical task table lives in tools/_runner.py (headline bench, TPU
+profile+HLO, BERT tokens/sec with no-fusion fallback, batch/layout
+ablations, dispatch timing, e2e input pipeline, transformer tokens/sec,
+434-case consistency oracle) — each step in a subprocess with a hard
+timeout so one hang cannot kill the batch.  A step only counts as ok if
+its measurement really ran on the TPU backend (a CPU fallback is
+recorded rc-0 but ok-false and persists no artifact).  Artifacts land in
+docs/artifacts/ and a combined log in docs/artifacts/on_chip_suite.log.
 
     python tools/on_chip_suite.py [--quick]
-
-Steps:
-  1. bench.py                       ResNet-50 bs256 NHWC (headline)
-  2. bench.py BENCH_LAYOUT=NCHW     layout ablation
-  3. bench.py BENCH_BATCH=128       batch ablation (r3 measured bs128)
-  4. bench.py BENCH_MODEL=bert      BERT-base tokens/sec (BASELINE #2)
-  5. tools/bench_step.py --device tpu   eager Trainer vs fused ratio
-  6. tools/check_consistency.py     434-case cpu-vs-tpu oracle
-  7. tools/dump_hlo.py --platform tpu --profile-steps 5   HLO + profile
 """
 import argparse
 import json
 import os
-import subprocess
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(_REPO, "docs", "artifacts")
 
-
-def run(name, cmd, env_extra=None, timeout=1800, log=None):
-    env = dict(os.environ)
-    env.update(env_extra or {})
-    t0 = time.time()
-    print(f"=== {name}: {' '.join(cmd)} {env_extra or ''}", flush=True)
-    try:
-        p = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
-                           text=True, timeout=timeout)
-        out, rc = (p.stdout or ""), p.returncode
-        err = (p.stderr or "")[-2000:]
-    except subprocess.TimeoutExpired as te:
-        # keep whatever the child printed before the timeout: bench.py
-        # emits its primary JSON line as soon as it exists
-        out = te.stdout.decode() if isinstance(te.stdout, bytes) else (
-            te.stdout or "")
-        rc, err = -1, f"TIMEOUT after {timeout}s"
-    dt = round(time.time() - t0, 1)
-    rec = {"step": name, "rc": rc, "s": dt,
-           "stdout_tail": out.strip().splitlines()[-3:] if out else [],
-           "stderr_tail": err.strip().splitlines()[-3:] if err else []}
-    print(json.dumps(rec), flush=True)
-    if log is not None:
-        log.append(rec)
-    # persist any bench JSON line as its own artifact
-    for line in reversed(out.strip().splitlines()):
-        try:
-            j = json.loads(line)
-            if isinstance(j, dict) and "metric" in j:
-                path = os.path.join(ART, f"{name}.json")
-                with open(path, "w") as f:
-                    json.dump(j, f, indent=1)
-                break
-        except ValueError:
-            continue
-    return rc
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _runner import SKIP_IF, TASKS, VALIDATORS, run_task  # noqa: E402
 
 
 def main():
@@ -71,47 +36,30 @@ def main():
                     help="shorter timeouts, skip the full consistency sweep")
     args = ap.parse_args()
     os.makedirs(ART, exist_ok=True)
-    py = sys.executable
     log = []
-    t = 600 if args.quick else 1800
+    succeeded = set()
 
-    # BENCH_SECONDARY=0: the dedicated bench_bert step below covers the
-    # secondary metric; re-running BERT inside every ResNet step would
-    # burn chip time and could push a step past its timeout, discarding
-    # the already-measured headline
-    no_sec = {"BENCH_SECONDARY": "0"}
-    run("bench_resnet_bs256_nhwc", [py, "bench.py"], dict(no_sec),
-        timeout=t, log=log)
-    run("bench_resnet_bs256_nchw", [py, "bench.py"],
-        dict(no_sec, BENCH_LAYOUT="NCHW"), timeout=t, log=log)
-    run("bench_resnet_bs128_nhwc", [py, "bench.py"],
-        dict(no_sec, BENCH_BATCH="128"), timeout=t, log=log)
-    rc = run("bench_bert", [py, "bench.py"], {"BENCH_MODEL": "bert"},
-             timeout=t, log=log)
-    if rc != 0:
-        # Pallas lowering through the relay is the likeliest failure; the
-        # dense-attention path is numerically equivalent (MXNET_USE_FUSION
-        # is the reference's fusion kill-switch)
-        run("bench_bert_nofusion", [py, "bench.py"],
-            {"BENCH_MODEL": "bert", "MXNET_USE_FUSION": "0"},
-            timeout=t, log=log)
-    run("bench_transformer_base", [py, "bench.py"],
-        {"BENCH_MODEL": "transformer"}, timeout=t, log=log)
-    run("bench_step_eager_vs_fused",
-        [py, "tools/bench_step.py", "--device", "tpu", "--batch", "64",
-         "--res", "64", "--steps", "5"], timeout=t, log=log)
-    if not args.quick:
-        run("check_consistency", [py, "tools/check_consistency.py"],
-            timeout=3000, log=log)
-    run("dump_hlo_tpu",
-        [py, "tools/dump_hlo.py", "--platform", "tpu", "--batch", "256",
-         "--profile-steps", "5"], timeout=t, log=log)
+    for name, argv, extra_env, timeout in TASKS:
+        if name in SKIP_IF and SKIP_IF[name] in succeeded:
+            continue  # e.g. no-fusion BERT fallback after a clean BERT run
+        if args.quick:
+            if name == "consistency":
+                continue
+            timeout = min(timeout, 600)
+        print(f"=== {name}: {' '.join(argv)} {extra_env or ''}", flush=True)
+        ok, rec = run_task(name, argv, extra_env, timeout,
+                           validator=VALIDATORS.get(name))
+        rec["ok"] = ok
+        print(json.dumps(rec), flush=True)
+        log.append(rec)
+        if ok:
+            succeeded.add(name)
 
     with open(os.path.join(ART, "on_chip_suite.log"), "w") as f:
         json.dump(log, f, indent=1)
-    print("suite complete:",
-          sum(1 for r in log if r["rc"] == 0), "/", len(log), "steps ok")
+    print("suite complete:", len(succeeded), "/", len(log), "steps ok")
+    return 0 if len(succeeded) == len(log) else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
