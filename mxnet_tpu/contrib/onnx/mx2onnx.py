@@ -1,0 +1,406 @@
+"""Symbol-graph -> ONNX ModelProto exporter.
+
+Reference parity: python/mxnet/contrib/onnx/mx2onnx/ (~L1-2500, per-op
+`convert_*` translators registered by op name).  Same architecture here —
+a translator registry keyed by the symbol op name — but emitting wire
+format through ``proto.py`` instead of the onnx package's generated
+classes (the wheel does not exist in this image).
+
+Supported surface: the inference graph of every model-zoo family in this
+tree (Convolution/BatchNorm/Pooling/FullyConnected/Activation chains,
+residual adds, concat, dropout, flatten/reshape/transpose, softmax,
+reductions, Split) at opset 11.  Unsupported ops raise with the op name
+so the gap is explicit, mirroring the reference's
+AttributeError("No conversion function registered for op type ...").
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+from . import proto as P
+
+OPSET = 11
+
+
+class _Ctx:
+    """Per-export state shared by translators."""
+
+    def __init__(self, dtype):
+        self.dtype = np.dtype(dtype)
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.init_names: set = set()
+        self._uid = 0
+
+    def add_node(self, op_type, inputs, outputs, name="", **attrs):
+        self.nodes.append(P.make_node(op_type, inputs, outputs,
+                                      name=name, **attrs))
+
+    def add_initializer(self, name, array):
+        if name in self.init_names:
+            return name
+        self.init_names.add(name)
+        self.initializers.append(P.make_tensor(name, np.asarray(array)))
+        return name
+
+    def scalar(self, value, name_hint):
+        self._uid += 1
+        return self.add_initializer(
+            f"{name_hint}_const{self._uid}",
+            np.asarray(value, dtype=self.dtype))
+
+    def tmp(self, base):
+        self._uid += 1
+        return f"{base}_tmp{self._uid}"
+
+
+def _pair(attrs, key, ndim, default):
+    v = attrs.get(key) or ()
+    v = list(v) if isinstance(v, (tuple, list)) else [v]
+    return [int(x) for x in (v or [default] * ndim)]
+
+
+def _pads(pad):  # MXNet symmetric pad -> ONNX begin+end
+    return [int(p) for p in pad] * 2
+
+
+_REGISTRY: Dict[str, callable] = {}
+
+
+def _register(*op_names):
+    def deco(fn):
+        for n in op_names:
+            _REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# translators — signature: fn(ctx, node, ins, outs, attrs)
+#   ins: ONNX names of the node's inputs, outs: names of its outputs
+# --------------------------------------------------------------------------
+
+
+@_register("Convolution")
+def _conv(ctx, node, ins, outs, attrs):
+    if attrs.get("layout") not in (None, "NCHW", "NCW", "NCDHW"):
+        raise MXNetError("ONNX export supports channel-first Convolution "
+                         f"only, got layout={attrs['layout']!r}")
+    kernel = [int(k) for k in attrs.get("kernel", ())]
+    ndim = len(kernel)
+    ctx.add_node(
+        "Conv", ins, outs, name=node.name,
+        kernel_shape=kernel,
+        strides=_pair(attrs, "stride", ndim, 1),
+        dilations=_pair(attrs, "dilate", ndim, 1),
+        pads=_pads(_pair(attrs, "pad", ndim, 0)),
+        group=int(attrs.get("num_group", 1)))
+
+
+@_register("BatchNorm")
+def _batchnorm(ctx, node, ins, outs, attrs):
+    # fix_gamma=True (the op default) means scale is semantically all-ones
+    # regardless of the stored array — materialize that (reference
+    # mx2onnx does the same)
+    ctx.add_node(
+        "BatchNormalization", ins, outs[:1], name=node.name,
+        epsilon=float(attrs.get("eps", 1e-3)),
+        momentum=float(attrs.get("momentum", 0.9)))
+
+
+@_register("FullyConnected")
+def _fc(ctx, node, ins, outs, attrs):
+    data = ins[0]
+    if attrs.get("flatten", True):
+        flat = ctx.tmp(node.name)
+        ctx.add_node("Flatten", [data], [flat], axis=1)
+        data = flat
+    ctx.add_node("Gemm", [data] + list(ins[1:]), outs, name=node.name,
+                 alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@_register("Activation")
+def _activation(ctx, node, ins, outs, attrs):
+    mapping = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus", "softsign": "Softsign"}
+    act = attrs.get("act_type", "relu")
+    if act not in mapping:
+        raise MXNetError(f"ONNX export: Activation act_type={act!r}")
+    ctx.add_node(mapping[act], ins, outs, name=node.name)
+
+
+@_register("LeakyReLU")
+def _leaky(ctx, node, ins, outs, attrs):
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins, outs, name=node.name, alpha=slope)
+    elif act == "elu":
+        ctx.add_node("Elu", ins, outs, name=node.name, alpha=slope)
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, outs, name=node.name)
+    else:
+        raise MXNetError(f"ONNX export: LeakyReLU act_type={act!r}")
+
+
+@_register("Pooling")
+def _pooling(ctx, node, ins, outs, attrs):
+    pool = attrs.get("pool_type", "max")
+    if pool not in ("max", "avg"):
+        raise MXNetError(f"ONNX export: pool_type={pool!r}")
+    if attrs.get("global_pool", False):
+        op = "GlobalMaxPool" if pool == "max" else "GlobalAveragePool"
+        ctx.add_node(op, ins, outs, name=node.name)
+        return
+    kernel = [int(k) for k in attrs.get("kernel", ())]
+    ndim = len(kernel)
+    kw = dict(kernel_shape=kernel,
+              strides=_pair(attrs, "stride", ndim, 1),
+              pads=_pads(_pair(attrs, "pad", ndim, 0)),
+              ceil_mode=int(attrs.get("pooling_convention",
+                                      "valid") == "full"))
+    if pool == "avg":
+        kw["count_include_pad"] = int(attrs.get("count_include_pad", True))
+        ctx.add_node("AveragePool", ins, outs, name=node.name, **kw)
+    else:
+        ctx.add_node("MaxPool", ins, outs, name=node.name, **kw)
+
+
+@_register("Flatten")
+def _flatten(ctx, node, ins, outs, attrs):
+    ctx.add_node("Flatten", ins, outs, name=node.name, axis=1)
+
+
+@_register("Dropout")
+def _dropout(ctx, node, ins, outs, attrs):
+    ctx.add_node("Dropout", ins, outs[:1], name=node.name,
+                 ratio=float(attrs.get("p", 0.5)))
+
+
+def _check_softmax_axis(node, attrs):
+    # ONNX Softmax-11 has coerce-to-2D semantics: it flattens [d0..dk-1],
+    # [dk..dn] and normalizes each row, which equals MXNet's single-axis
+    # softmax ONLY when the axis is the last one.  axis=-1 is the op
+    # default here and what every classifier head uses; other axes would
+    # export a silently different model, so they raise.
+    axis = int(attrs.get("axis", -1))
+    if axis != -1:
+        raise MXNetError(
+            f"ONNX export: {node.op} axis={axis} differs from ONNX "
+            "opset-11 flatten semantics (only axis=-1 is equivalent)")
+    return axis
+
+
+@_register("softmax", "SoftmaxActivation")
+def _softmax(ctx, node, ins, outs, attrs):
+    ctx.add_node("Softmax", ins, outs, name=node.name,
+                 axis=_check_softmax_axis(node, attrs))
+
+
+@_register("log_softmax")
+def _log_softmax(ctx, node, ins, outs, attrs):
+    ctx.add_node("LogSoftmax", ins, outs, name=node.name,
+                 axis=_check_softmax_axis(node, attrs))
+
+
+@_register("SoftmaxOutput")
+def _softmax_output(ctx, node, ins, outs, attrs):
+    # inference export: the label input and loss semantics drop away
+    # (reference mx2onnx emits plain Softmax)
+    ctx.add_node("Softmax", ins[:1], outs, name=node.name, axis=-1)
+
+
+_BINARY = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
+           "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_minus": "Sub",
+           "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
+           "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
+
+
+@_register(*_BINARY)
+def _binary(ctx, node, ins, outs, attrs):
+    ctx.add_node(_BINARY[node.op], ins, outs, name=node.name)
+
+
+_SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+           "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+           "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True)}
+
+
+@_register(*_SCALAR)
+def _scalar_op(ctx, node, ins, outs, attrs):
+    op, reverse = _SCALAR[node.op]
+    const = ctx.scalar(float(attrs.get("scalar", 0.0)), node.name)
+    inputs = [const, ins[0]] if reverse else [ins[0], const]
+    ctx.add_node(op, inputs, outs, name=node.name)
+
+
+@_register("add_n", "ElementWiseSum")
+def _add_n(ctx, node, ins, outs, attrs):
+    ctx.add_node("Sum", ins, outs, name=node.name)
+
+
+@_register("Concat", "concat")
+def _concat(ctx, node, ins, outs, attrs):
+    ctx.add_node("Concat", ins, outs, name=node.name,
+                 axis=int(attrs.get("dim", 1)))
+
+
+@_register("Reshape", "reshape")
+def _reshape(ctx, node, ins, outs, attrs):
+    shape = [int(s) for s in attrs.get("shape", ())]
+    if any(s < -1 for s in shape):
+        raise MXNetError("ONNX export: Reshape special codes -2/-3/-4 have "
+                         "no ONNX equivalent; use explicit dims")
+    shp = ctx.add_initializer(f"{node.name}_shape",
+                              np.asarray(shape, dtype=np.int64))
+    ctx.add_node("Reshape", [ins[0], shp], outs, name=node.name)
+
+
+@_register("transpose")
+def _transpose(ctx, node, ins, outs, attrs):
+    axes = attrs.get("axes", ())
+    kw = {"perm": [int(a) for a in axes]} if axes else {}
+    ctx.add_node("Transpose", ins, outs, name=node.name, **kw)
+
+
+@_register("clip")
+def _clip(ctx, node, ins, outs, attrs):
+    lo = ctx.scalar(float(attrs["a_min"]), node.name)
+    hi = ctx.scalar(float(attrs["a_max"]), node.name)
+    ctx.add_node("Clip", [ins[0], lo, hi], outs, name=node.name)
+
+
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+          "negative": "Neg", "erf": "Erf", "floor": "Floor",
+          "ceil": "Ceil", "BlockGrad": "Identity", "identity": "Identity",
+          "stop_gradient": "Identity"}
+
+
+@_register(*_UNARY)
+def _unary(ctx, node, ins, outs, attrs):
+    ctx.add_node(_UNARY[node.op], ins, outs, name=node.name)
+
+
+@_register("mean", "sum")
+def _reduce(ctx, node, ins, outs, attrs):
+    op = "ReduceMean" if node.op == "mean" else "ReduceSum"
+    if attrs.get("exclude", False):
+        raise MXNetError(
+            f"ONNX export: {node.op} exclude=True has no ONNX axes "
+            "equivalent without rank info; reduce over explicit axes")
+    axis = attrs.get("axis", None)
+    kw = {"keepdims": int(attrs.get("keepdims", False))}
+    if axis is not None:
+        kw["axes"] = ([int(axis)] if isinstance(axis, (int, np.integer))
+                      else [int(a) for a in axis])
+    ctx.add_node(op, ins, outs, name=node.name, **kw)
+
+
+@_register("SliceChannel", "split")
+def _split(ctx, node, ins, outs, attrs):
+    axis = int(attrs.get("axis", 1))
+    if attrs.get("squeeze_axis", False):
+        # MXNet drops the split axis from each part; ONNX Split keeps it —
+        # emit a Squeeze per output
+        parts = [ctx.tmp(node.name) for _ in outs]
+        ctx.add_node("Split", ins, parts, name=node.name, axis=axis)
+        for part, out in zip(parts, outs):
+            ctx.add_node("Squeeze", [part], [out], axes=[axis])
+    else:
+        ctx.add_node("Split", ins, outs, name=node.name, axis=axis)
+
+
+@_register("Cast", "cast")
+def _cast(ctx, node, ins, outs, attrs):
+    ctx.add_node("Cast", ins, outs, name=node.name,
+                 to=P.np_to_onnx_dtype(attrs["dtype"]))
+
+
+# --------------------------------------------------------------------------
+# graph walk
+# --------------------------------------------------------------------------
+
+
+def _out_names(node) -> List[str]:
+    if node.num_outputs == 1:
+        return [node.name]
+    return [f"{node.name}_output{i}" for i in range(node.num_outputs)]
+
+
+def export_symbol(sym, params: Dict[str, np.ndarray],
+                  input_shapes: Sequence[Tuple[int, ...]],
+                  input_dtype=np.float32) -> bytes:
+    """Serialize `sym` + `params` to ONNX ModelProto bytes (opset 11)."""
+    from ...symbol.symbol import _topo_order
+
+    ctx = _Ctx(input_dtype)
+    params = {k.split(":", 1)[-1]: np.asarray(
+        v.asnumpy() if hasattr(v, "asnumpy") else v) for k, v in
+        params.items()}
+
+    order = _topo_order(sym._entries)
+    free_inputs = [n for n in order
+                   if n.is_variable() and n.name not in params]
+    if len(free_inputs) != len(input_shapes):
+        raise MXNetError(
+            f"export_model: graph has {len(free_inputs)} data inputs "
+            f"({[n.name for n in free_inputs]}) but {len(input_shapes)} "
+            "input shapes were given")
+
+    # graph-wide shape inference for value infos (also validates params)
+    shape_kwargs = {n.name: tuple(s)
+                    for n, s in zip(free_inputs, input_shapes)}
+    try:
+        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+    except Exception:
+        out_shapes = [None] * len(sym._entries)
+
+    fix_gamma_inits = {}
+    for node in order:
+        if node.op == "BatchNorm" and node.attrs.get("fix_gamma", True):
+            gamma = node.inputs[1][0]
+            if gamma.is_variable() and gamma.name in params:
+                fix_gamma_inits[gamma.name] = np.ones_like(
+                    params[gamma.name])
+
+    elem_type = P.np_to_onnx_dtype(input_dtype)
+    graph_inputs = []
+    for node in order:
+        if not node.is_variable():
+            continue
+        if node.name in params:
+            arr = fix_gamma_inits.get(node.name, params[node.name])
+            ctx.add_initializer(node.name, arr.astype(ctx.dtype)
+                                if arr.dtype.kind == "f" else arr)
+        else:
+            graph_inputs.append(P.make_tensor_value_info(
+                node.name, elem_type, shape_kwargs[node.name]))
+
+    for node in order:
+        if node.is_variable():
+            continue
+        if node.op not in _REGISTRY:
+            raise MXNetError(
+                f"No ONNX conversion registered for op {node.op!r} "
+                f"(node {node.name!r}) — supported: "
+                f"{sorted(_REGISTRY)}")
+        ins = []
+        for parent, oidx in node.inputs:
+            ins.append(parent.name if parent.num_outputs == 1
+                       else _out_names(parent)[oidx])
+        _REGISTRY[node.op](ctx, node, ins, _out_names(node), node.attrs)
+
+    graph_outputs = []
+    for (node, oidx), oshape in zip(sym._entries, out_shapes):
+        graph_outputs.append(P.make_tensor_value_info(
+            _out_names(node)[oidx] if not node.is_variable() else node.name,
+            elem_type, oshape))
+
+    graph_name = getattr(sym, "name", None) or "mxnet_tpu_graph"
+    graph = P.make_graph(ctx.nodes, graph_name,
+                         graph_inputs, graph_outputs, ctx.initializers)
+    return P.make_model(graph, opset=OPSET)
