@@ -193,6 +193,24 @@ def _run_child(platform, timeout, history, extra_env=None):
     return None
 
 
+def _session_tpu_artifact(model):
+    """The matching on-chip artifact captured earlier this session by
+    tools/relay_watch.py / on_chip_suite.py, or None."""
+    name = {"bert": "bench_bert",
+            "transformer": "bench_transformer"}.get(
+        model, "bench_resnet_bs256_nhwc")
+    art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "docs", "artifacts", f"{name}.json")
+    try:
+        with open(art) as f:
+            tpu_art = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(tpu_art, dict):  # truncated/garbled artifact file
+        return None
+    return tpu_art if tpu_art.get("platform") == "tpu" else None
+
+
 def main():
     history = []
     on_tpu = _probe_tpu(history)
@@ -222,6 +240,17 @@ def main():
     else:
         result["probe_history"] = history
 
+    # A dead relay at round end must not erase the round's on-chip
+    # evidence: when this run could only produce a CPU (or error)
+    # fallback, attach the session's captured TPU artifact (written by
+    # tools/relay_watch.py / on_chip_suite the moment a relay window
+    # answered) so the BENCH_r* record carries the real measurement with
+    # its provenance alongside the fallback value.
+    if result.get("platform") != "tpu":
+        tpu_art = _session_tpu_artifact(os.environ.get("BENCH_MODEL"))
+        if tpu_art is not None:
+            result["tpu_artifact"] = tpu_art
+
     # the hard-won primary number goes out IMMEDIATELY — if the driver's
     # outer timeout kills us during the secondary below, the artifact
     # still has the headline (the last parseable line is authoritative)
@@ -242,6 +271,10 @@ def main():
                          extra_env={"BENCH_MODEL": "bert"})
         if sec is not None:
             sec.pop("probe_history", None)
+            if sec.get("platform") != "tpu":
+                sec_art = _session_tpu_artifact("bert")
+                if sec_art is not None:
+                    sec["tpu_artifact"] = sec_art
             result["secondary"] = sec
             print(json.dumps(result), flush=True)
 

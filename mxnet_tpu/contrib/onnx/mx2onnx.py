@@ -102,6 +102,11 @@ def _conv(ctx, node, ins, outs, attrs):
 
 @_register("BatchNorm")
 def _batchnorm(ctx, node, ins, outs, attrs):
+    # ONNX BatchNormalization is fixed to channel axis 1
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError("ONNX export: BatchNorm axis="
+                         f"{attrs['axis']} unsupported (ONNX "
+                         "BatchNormalization normalizes axis 1 only)")
     # fix_gamma=True (the op default) means scale is semantically all-ones
     # regardless of the stored array — materialize that (reference
     # mx2onnx does the same)
@@ -194,10 +199,21 @@ def _check_softmax_axis(node, attrs):
     return axis
 
 
-@_register("softmax", "SoftmaxActivation")
+@_register("softmax")
 def _softmax(ctx, node, ins, outs, attrs):
     ctx.add_node("Softmax", ins, outs, name=node.name,
                  axis=_check_softmax_axis(node, attrs))
+
+
+@_register("SoftmaxActivation")
+def _softmax_activation(ctx, node, ins, outs, attrs):
+    # mode='instance' (the default) softmaxes over ALL non-batch dims —
+    # exactly ONNX opset-11 Softmax(axis=1) flatten semantics.
+    # mode='channel' (axis-1-only on rank>2) has no opset-11 equivalent.
+    if attrs.get("mode", "instance") != "instance":
+        raise MXNetError("ONNX export: SoftmaxActivation mode='channel' "
+                         "has no opset-11 Softmax equivalent")
+    ctx.add_node("Softmax", ins, outs, name=node.name, axis=1)
 
 
 @_register("log_softmax")
@@ -209,7 +225,12 @@ def _log_softmax(ctx, node, ins, outs, attrs):
 @_register("SoftmaxOutput")
 def _softmax_output(ctx, node, ins, outs, attrs):
     # inference export: the label input and loss semantics drop away
-    # (reference mx2onnx emits plain Softmax)
+    # (reference mx2onnx emits plain Softmax).  multi_output=True moves
+    # the softmax to axis 1 of a rank-4 tensor (per-pixel heads), which
+    # opset-11 flatten semantics cannot express.
+    if attrs.get("multi_output", False):
+        raise MXNetError("ONNX export: SoftmaxOutput multi_output=True "
+                         "has no opset-11 Softmax equivalent")
     ctx.add_node("Softmax", ins[:1], outs, name=node.name, axis=-1)
 
 

@@ -114,15 +114,6 @@ def enc_float(field: int, value: float) -> bytes:
     return _tag(field, 5) + struct.pack("<f", float(value))
 
 
-def enc_packed_int64(field: int, values: Sequence[int]) -> bytes:
-    payload = b"".join(_varint(int(v)) for v in values)
-    return enc_bytes(field, payload)
-
-
-def enc_packed_float(field: int, values: Sequence[float]) -> bytes:
-    return enc_bytes(field, struct.pack(f"<{len(values)}f", *values))
-
-
 # --------------------------------------------------------------------------
 # wire-format primitives (decode)
 # --------------------------------------------------------------------------
