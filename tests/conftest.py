@@ -18,10 +18,15 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: repeat suite runs on this VM skip XLA
 # compilation for the model-sized programs (the suite is compile-heavy)
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("MXNET_TEST_JAX_CACHE",
-                                 "/tmp/mxnet_tpu_test_jax_cache"))
+_JAX_CACHE = os.environ.get("MXNET_TEST_JAX_CACHE",
+                            "/tmp/mxnet_tpu_test_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# subprocess children (dist workers, examples-e2e, launcher tests) must
+# inherit the persistent cache too — they dominate suite wall time and
+# otherwise recompile their BERT/ResNet programs cold on every run
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_CACHE)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

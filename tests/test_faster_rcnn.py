@@ -85,21 +85,23 @@ def test_faster_rcnn_forward_shapes():
 
 
 def test_faster_rcnn_train_step_decreases_loss():
+    """The 4-loss RPN+ROI train step — target assignment, NMS proposals
+    and all — runs as ONE fused XLA program via DataParallelStep (the
+    block IS the loss; a dummy label feeds the unused slot)."""
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
     net = _net()
     loss_block = FasterRCNNTrainLoss(net)
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 1e-3})
     x, gt, im_info = _batch()
     loss_block(x, gt, im_info)  # resolve deferred shapes (incl. the roi
-    # head's dense layers), then compile the 4-loss graph once
-    loss_block.hybridize()
-    losses = []
-    for _ in range(12):
-        with autograd.record():
-            loss = loss_block(x, gt, im_info)
-        loss.backward()
-        trainer.step(2)
-        losses.append(float(loss.asscalar()))
+    # head's dense layers) before the fused trace
+    step = DataParallelStep(
+        loss_block, lambda out, label: out,
+        mesh=local_mesh(devices=[mx.current_context().jax_device]),
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3})
+    dummy = nd.zeros((2,))
+    losses = [float(np.asarray(step.step((x, gt, im_info), dummy)))
+              for _ in range(12)]
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
 

@@ -48,6 +48,12 @@ TASKS = [
     ("bench_resnet_bs128_nhwc",
      [_PY, "bench.py"], {"BENCH_BATCH": "128", "BENCH_SECONDARY": "0"},
      1200),
+    # dispatch-overhead ablation: all steps inside one lax.scan program —
+    # the delta vs the headline per-step-dispatch number IS the relay
+    # dispatch cost (docs/PERF.md r5 reading)
+    ("bench_resnet_bs256_scan",
+     [_PY, "bench.py"], {"BENCH_SCAN": "1", "BENCH_SECONDARY": "0"},
+     1200),
     ("bench_resnet_bs256_nchw",
      [_PY, "bench.py"], {"BENCH_LAYOUT": "NCHW", "BENCH_SECONDARY": "0"},
      1200),
