@@ -200,7 +200,8 @@ def _session_tpu_artifact(model):
     SEQLEN override) must not carry the headline artifact, or readers
     comparing variant records would see identical embedded numbers and
     conclude a zero delta."""
-    for var in ("BENCH_BATCH", "BENCH_LAYOUT", "BENCH_SEQLEN", "BENCH_RES"):
+    for var in ("BENCH_BATCH", "BENCH_LAYOUT", "BENCH_SEQLEN",
+                "BENCH_RES", "BENCH_REMAT"):
         if os.environ.get(var) is not None:
             return None
     if os.environ.get("BENCH_SCAN", "0") == "1":
@@ -447,7 +448,10 @@ def bench_resnet(platform):
     step = DataParallelStep(
         net, loss_fn, mesh=local_mesh(devices=[ctx.jax_device]),
         optimizer="sgd",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        # BENCH_REMAT=1: activation rematerialization — HBM headroom for
+        # the bs512 ablation (is bs256 underutilizing the chip?)
+        remat=os.environ.get("BENCH_REMAT", "0") == "1")
 
     shape = (batch, 3, res, res) if layout == "NCHW" else (batch, res, res, 3)
     x = np.random.rand(*shape).astype("float32")
@@ -513,6 +517,8 @@ def bench_resnet(platform):
     if scan_mode:
         rec["mode"] = "scan"
         rec["scan_steps"] = steps
+    if os.environ.get("BENCH_REMAT", "0") == "1":
+        rec["remat"] = True
     print(json.dumps(rec))
 
 

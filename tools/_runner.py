@@ -54,6 +54,12 @@ TASKS = [
     ("bench_resnet_bs256_scan",
      [_PY, "bench.py"], {"BENCH_SCAN": "1", "BENCH_SECONDARY": "0"},
      1200),
+    # batch-scaling headroom probe: bs512 + remat (fails harmlessly if it
+    # doesn't fit HBM; succeeds -> bs256 was underutilizing the chip)
+    ("bench_resnet_bs512_remat",
+     [_PY, "bench.py"],
+     {"BENCH_BATCH": "512", "BENCH_REMAT": "1", "BENCH_SECONDARY": "0"},
+     1200),
     ("bench_resnet_bs256_nchw",
      [_PY, "bench.py"], {"BENCH_LAYOUT": "NCHW", "BENCH_SECONDARY": "0"},
      1200),

@@ -50,8 +50,35 @@ def sentinel(name):
     return os.path.join(ART, f".watch_done_{name}")
 
 
+MAX_GENUINE_FAILURES = 2
+
+
+def fail_marker(name):
+    return os.path.join(ART, f".watch_failed_{name}")
+
+
+def _genuine_failures(name):
+    try:
+        with open(fail_marker(name)) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def note_genuine_failure(name):
+    """Task failed while the relay was ALIVE (post-failure probe passed):
+    a real task problem (e.g. bs512 genuinely OOMs), not a closed window.
+    After MAX_GENUINE_FAILURES the task is retired so it stops burning
+    scarce relay time ahead of lower-priority tasks."""
+    n = _genuine_failures(name) + 1
+    with open(fail_marker(name), "w") as f:
+        f.write(str(n))
+    return n
+
+
 def _done(name):
-    return os.path.exists(sentinel(name)) or artifact_done(name)
+    return (os.path.exists(sentinel(name)) or artifact_done(name)
+            or _genuine_failures(name) >= MAX_GENUINE_FAILURES)
 
 
 def _skip(name):
@@ -77,7 +104,13 @@ def main():
                         f.write(json.dumps(
                             {"done_at": time.strftime("%F %T"),
                              "s": rec["s"]}))
-                elif not probe():
+                elif probe():
+                    # relay still alive -> the TASK failed (OOM, bug):
+                    # count it; retire after MAX_GENUINE_FAILURES
+                    n = note_genuine_failure(name)
+                    print(json.dumps({"genuine_failure": name, "count": n}),
+                          flush=True)
+                else:
                     break  # window closed — back to sleep
         time.sleep(RETRY_SLEEP)
 
