@@ -33,7 +33,21 @@ class _Ctx:
         self.nodes: List[bytes] = []
         self.initializers: List[bytes] = []
         self.init_names: set = set()
+        self.structs: Dict[int, list] = {}  # id(node) -> ShapeDtypeStructs
         self._uid = 0
+
+    def in_struct(self, node, i):
+        """ShapeDtypeStruct of node's i-th input (None when inference
+        couldn't resolve it)."""
+        parent, oidx = node.inputs[i]
+        lst = self.structs.get(id(parent))
+        if lst is None:
+            return None
+        return lst[oidx] if oidx < len(lst) else None
+
+    def in_rank(self, node, i):
+        s = self.in_struct(node, i)
+        return None if s is None else len(s.shape)
 
     def add_node(self, op_type, inputs, outputs, name="", **attrs):
         self.nodes.append(P.make_node(op_type, inputs, outputs,
@@ -46,11 +60,15 @@ class _Ctx:
         self.initializers.append(P.make_tensor(name, np.asarray(array)))
         return name
 
-    def scalar(self, value, name_hint):
+    def scalar(self, value, name_hint, dtype=None):
+        if dtype is None:
+            # float export dtype governs float constants (a float64 export
+            # must emit DOUBLE clip bounds/eps); int input dtypes don't
+            dtype = self.dtype if self.dtype.kind == "f" else np.float32
         self._uid += 1
         return self.add_initializer(
             f"{name_hint}_const{self._uid}",
-            np.asarray(value, dtype=self.dtype))
+            np.asarray(value, dtype=dtype))
 
     def tmp(self, base):
         self._uid += 1
@@ -122,9 +140,20 @@ def _fc(ctx, node, ins, outs, attrs):
     if attrs.get("flatten", True):
         flat = ctx.tmp(node.name)
         ctx.add_node("Flatten", [data], [flat], axis=1)
-        data = flat
-    ctx.add_node("Gemm", [data] + list(ins[1:]), outs, name=node.name,
-                 alpha=1.0, beta=1.0, transA=0, transB=1)
+        ctx.add_node("Gemm", [flat] + list(ins[1:]), outs, name=node.name,
+                     alpha=1.0, beta=1.0, transA=0, transB=1)
+        return
+    # flatten=False: per-position projection on rank>=2 input — Gemm is
+    # 2D-only, so emit MatMul(x, W^T) (+ bias); runtimes constant-fold
+    # the weight transpose
+    wt = ctx.tmp(node.name)
+    ctx.add_node("Transpose", [ins[1]], [wt], perm=[1, 0])
+    if len(ins) > 2:
+        mm = ctx.tmp(node.name)
+        ctx.add_node("MatMul", [data, wt], [mm])
+        ctx.add_node("Add", [mm, ins[2]], outs, name=node.name)
+    else:
+        ctx.add_node("MatMul", [data, wt], outs, name=node.name)
 
 
 @_register("Activation")
@@ -253,7 +282,9 @@ _SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
 @_register(*_SCALAR)
 def _scalar_op(ctx, node, ins, outs, attrs):
     op, reverse = _SCALAR[node.op]
-    const = ctx.scalar(float(attrs.get("scalar", 0.0)), node.name)
+    s = ctx.in_struct(node, 0)  # ONNX binaries need matching dtypes
+    const = ctx.scalar(attrs.get("scalar", 0.0), node.name,
+                       dtype=None if s is None else s.dtype)
     inputs = [const, ins[0]] if reverse else [ins[0], const]
     ctx.add_node(op, inputs, outs, name=node.name)
 
@@ -341,6 +372,184 @@ def _cast(ctx, node, ins, outs, attrs):
                  to=P.np_to_onnx_dtype(attrs["dtype"]))
 
 
+# ---- transformer-family ops ----------------------------------------------
+
+
+@_register("Embedding")
+def _embedding(ctx, node, ins, outs, attrs):
+    # table lookup = Gather(weight, indices) on axis 0; MXNet accepts
+    # float indices, ONNX does not — cast when inference says float
+    indices = ins[0]
+    s = ctx.in_struct(node, 0)
+    if s is None or np.dtype(s.dtype).kind == "f":
+        cast = ctx.tmp(node.name)
+        ctx.add_node("Cast", [indices], [cast], to=P.INT32)
+        indices = cast
+    ctx.add_node("Gather", [ins[1], indices], outs, name=node.name, axis=0)
+
+
+@_register("LayerNorm")
+def _layer_norm(ctx, node, ins, outs, attrs):
+    if attrs.get("output_mean_var", False):
+        raise MXNetError("ONNX export: LayerNorm output_mean_var=True")
+    axis = int(attrs.get("axis", -1))
+    rank = ctx.in_rank(node, 0)
+    if axis != -1 and (rank is None or axis != rank - 1):
+        # gamma/beta are (C,): only last-axis normalization broadcasts them
+        # correctly in the decomposition below
+        raise MXNetError(f"ONNX export: LayerNorm axis={axis} (only the "
+                         "last axis is supported)")
+    x, gamma, beta = ins
+    t = lambda: ctx.tmp(node.name)  # noqa: E731
+    mean, cent, sq, var, veps, std, norm, scaled = (
+        t(), t(), t(), t(), t(), t(), t(), t())
+    ctx.add_node("ReduceMean", [x], [mean], axes=[-1], keepdims=1)
+    ctx.add_node("Sub", [x, mean], [cent])
+    ctx.add_node("Mul", [cent, cent], [sq])
+    ctx.add_node("ReduceMean", [sq], [var], axes=[-1], keepdims=1)
+    ctx.add_node("Add", [var, ctx.scalar(float(attrs.get("eps", 1e-5)),
+                                         node.name)], [veps])
+    ctx.add_node("Sqrt", [veps], [std])
+    ctx.add_node("Div", [cent, std], [norm])
+    ctx.add_node("Mul", [norm, gamma], [scaled])
+    ctx.add_node("Add", [scaled, beta], outs, name=node.name)
+
+
+def _maybe_transpose_last2(ctx, node, idx, name_in, flag):
+    if not flag:
+        return name_in
+    rank = ctx.in_rank(node, idx)
+    if rank is None:
+        raise MXNetError(f"ONNX export: {node.op} transpose flag needs "
+                         "rank info (shape inference failed upstream)")
+    perm = list(range(rank))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    tmp = ctx.tmp(node.name)
+    ctx.add_node("Transpose", [name_in], [tmp], perm=perm)
+    return tmp
+
+
+@_register("batch_dot")
+def _matmul(ctx, node, ins, outs, attrs):
+    a = _maybe_transpose_last2(ctx, node, 0, ins[0],
+                               attrs.get("transpose_a", False))
+    b = _maybe_transpose_last2(ctx, node, 1, ins[1],
+                               attrs.get("transpose_b", False))
+    ctx.add_node("MatMul", [a, b], outs, name=node.name)
+
+
+@_register("dot")
+def _dot(ctx, node, ins, outs, attrs):
+    # MXNet dot is TENSORDOT (contracts a's last axis with b's FIRST
+    # axis, full cyclic transposes) — only the rank-2 case coincides
+    # with ONNX MatMul semantics
+    if ctx.in_rank(node, 0) != 2 or ctx.in_rank(node, 1) != 2:
+        raise MXNetError("ONNX export: dot is only exportable for 2-D "
+                         "operands (rank>2 dot is tensordot, not MatMul); "
+                         "use batch_dot for batched matmul")
+    _matmul(ctx, node, ins, outs, attrs)
+
+
+@_register("expand_dims")
+def _expand_dims(ctx, node, ins, outs, attrs):
+    ctx.add_node("Unsqueeze", ins, outs, name=node.name,
+                 axes=[int(attrs["axis"])])
+
+
+@_register("squeeze")
+def _squeeze(ctx, node, ins, outs, attrs):
+    axis = attrs.get("axis", None)
+    kw = {}
+    if axis is not None:
+        kw["axes"] = ([int(axis)] if isinstance(axis, (int, np.integer))
+                      else [int(a) for a in axis])
+    ctx.add_node("Squeeze", ins, outs, name=node.name, **kw)
+
+
+@_register("broadcast_axis")
+def _broadcast_axis(ctx, node, ins, outs, attrs):
+    # Expand to the inferred output shape (size-1 dims tile per ONNX
+    # broadcast rules, same as the op's semantics)
+    lst = ctx.structs.get(id(node))
+    if not lst or lst[0] is None:
+        raise MXNetError("ONNX export: broadcast_axis needs shape "
+                         "inference for its Expand target")
+    shp = ctx.add_initializer(
+        f"{node.name}_target",
+        np.asarray(lst[0].shape, dtype=np.int64))
+    ctx.add_node("Expand", [ins[0], shp], outs, name=node.name)
+
+
+_CMP_SCALAR = {"_greater_scalar": ("Greater", False),
+               "_lesser_scalar": ("Less", False),
+               "_greater_equal_scalar": ("Less", True),
+               "_lesser_equal_scalar": ("Greater", True),
+               "_equal_scalar": ("Equal", False)}
+
+
+@_register(*_CMP_SCALAR)
+def _cmp_scalar(ctx, node, ins, outs, attrs):
+    # MXNet comparisons return float 0/1; ONNX Greater/Less/Equal return
+    # bool — compare, optionally Not (for >= / <= via the negated op),
+    # then Cast back to the input dtype to keep arithmetic consumers valid
+    op, negate = _CMP_SCALAR[node.op]
+    s = ctx.in_struct(node, 0)
+    const = ctx.scalar(attrs.get("scalar", 0.0), node.name,
+                       dtype=None if s is None else s.dtype)
+    raw = ctx.tmp(node.name)
+    ctx.add_node(op, [ins[0], const], [raw])
+    if negate:
+        inv = ctx.tmp(node.name)
+        ctx.add_node("Not", [raw], [inv])
+        raw = inv
+    dtype = np.float32 if s is None else s.dtype
+    ctx.add_node("Cast", [raw], outs, name=node.name,
+                 to=P.np_to_onnx_dtype(dtype))
+
+
+@_register("where")
+def _where(ctx, node, ins, outs, attrs):
+    cond = ctx.tmp(node.name)
+    ctx.add_node("Cast", [ins[0]], [cond], to=P.BOOL)
+    ctx.add_node("Where", [cond, ins[1], ins[2]], outs, name=node.name)
+
+
+@_register("slice_axis")
+def _slice_axis(ctx, node, ins, outs, attrs):
+    axis = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0) or 0)
+    end = attrs.get("end", None)
+    end = (1 << 62) if end is None else int(end)
+    starts = ctx.add_initializer(f"{node.name}_starts",
+                                 np.asarray([begin], np.int64))
+    ends = ctx.add_initializer(f"{node.name}_ends",
+                               np.asarray([end], np.int64))
+    axes = ctx.add_initializer(f"{node.name}_axes",
+                               np.asarray([axis], np.int64))
+    ctx.add_node("Slice", [ins[0], starts, ends, axes], outs,
+                 name=node.name)
+
+
+@_register("slice")
+def _slice(ctx, node, ins, outs, attrs):
+    begin = list(attrs.get("begin", ()))
+    end = list(attrs.get("end", ()))
+    step = attrs.get("step") or ()
+    if any(s is not None and int(s) != 1 for s in step):
+        raise MXNetError("ONNX export: strided slice unsupported")
+    starts = [0 if b is None else int(b) for b in begin]
+    ends = [(1 << 62) if e is None else int(e) for e in end]
+    axes = list(range(len(begin)))
+
+    s = ctx.add_initializer(f"{node.name}_starts",
+                            np.asarray(starts, np.int64))
+    e = ctx.add_initializer(f"{node.name}_ends",
+                            np.asarray(ends, np.int64))
+    a = ctx.add_initializer(f"{node.name}_axes",
+                            np.asarray(axes, np.int64))
+    ctx.add_node("Slice", [ins[0], s, e, a], outs, name=node.name)
+
+
 # --------------------------------------------------------------------------
 # graph walk
 # --------------------------------------------------------------------------
@@ -372,13 +581,24 @@ def export_symbol(sym, params: Dict[str, np.ndarray],
             f"({[n.name for n in free_inputs]}) but {len(input_shapes)} "
             "input shapes were given")
 
-    # graph-wide shape inference for value infos (also validates params)
+    # graph-wide shape/dtype inference: per-node structs let translators
+    # that need rank/dtype (batch_dot transposes, Embedding index casts,
+    # broadcast_axis target shapes) emit correct graphs, and give every
+    # graph input/output its real elem_type
     shape_kwargs = {n.name: tuple(s)
                     for n, s in zip(free_inputs, input_shapes)}
     try:
-        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+        structs = sym._infer_structs(
+            shapes=shape_kwargs,
+            dtypes={n.name: np.dtype(input_dtype).name for n in free_inputs
+                    if not n.vattrs.get("dtype")},
+            partial=True)
+        ctx.structs = structs["nodes"]
+        var_structs = structs["vars"]
+        out_structs = structs["outs"]
     except Exception:
-        out_shapes = [None] * len(sym._entries)
+        var_structs = {}
+        out_structs = [None] * len(sym._entries)
 
     fix_gamma_inits = {}
     for node in order:
@@ -388,18 +608,32 @@ def export_symbol(sym, params: Dict[str, np.ndarray],
                 fix_gamma_inits[gamma.name] = np.ones_like(
                     params[gamma.name])
 
+    def _var_elem_type(name, default):
+        s = var_structs.get(name)
+        if s is None:
+            return default
+        try:
+            return P.np_to_onnx_dtype(s.dtype)
+        except ValueError:
+            return default
+
     elem_type = P.np_to_onnx_dtype(input_dtype)
     graph_inputs = []
     for node in order:
         if not node.is_variable():
             continue
         if node.name in params:
+            # a FLOAT export dtype casts float params with it (a float64
+            # export must be type-consistent end to end); an int input
+            # dtype (token models) must NOT touch float params
             arr = fix_gamma_inits.get(node.name, params[node.name])
-            ctx.add_initializer(node.name, arr.astype(ctx.dtype)
-                                if arr.dtype.kind == "f" else arr)
+            if ctx.dtype.kind == "f" and arr.dtype.kind == "f":
+                arr = arr.astype(ctx.dtype)
+            ctx.add_initializer(node.name, arr)
         else:
             graph_inputs.append(P.make_tensor_value_info(
-                node.name, elem_type, shape_kwargs[node.name]))
+                node.name, _var_elem_type(node.name, elem_type),
+                shape_kwargs[node.name]))
 
     for node in order:
         if node.is_variable():
@@ -416,10 +650,17 @@ def export_symbol(sym, params: Dict[str, np.ndarray],
         _REGISTRY[node.op](ctx, node, ins, _out_names(node), node.attrs)
 
     graph_outputs = []
-    for (node, oidx), oshape in zip(sym._entries, out_shapes):
+    for (node, oidx), ostruct in zip(sym._entries, out_structs):
+        oshape = None if ostruct is None else tuple(ostruct.shape)
+        otype = elem_type
+        if ostruct is not None:
+            try:
+                otype = P.np_to_onnx_dtype(ostruct.dtype)
+            except ValueError:
+                pass
         graph_outputs.append(P.make_tensor_value_info(
             _out_names(node)[oidx] if not node.is_variable() else node.name,
-            elem_type, oshape))
+            otype, oshape))
 
     graph_name = getattr(sym, "name", None) or "mxnet_tpu_graph"
     graph = P.make_graph(ctx.nodes, graph_name,

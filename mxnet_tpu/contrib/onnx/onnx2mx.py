@@ -118,7 +118,65 @@ class _Importer:
         self.set_out(node, [out])
 
     def op_MatMul(self, node, attrs, ins):
-        self.set_out(node, [self.sym().dot(*ins, name=self._name(node))])
+        # ONNX MatMul is batched over leading dims; linalg_gemm2 has the
+        # same contract (plain 2D included) — sym.dot would contract the
+        # wrong axes for rank>2
+        self.set_out(node, [self.sym().linalg.gemm2(
+            *ins, name=self._name(node))])
+
+    def op_Gather(self, node, attrs, ins):
+        self.set_out(node, [self.sym().take(
+            ins[0], ins[1], axis=int(attrs.get("axis", 0)),
+            name=self._name(node))])
+
+    def op_Expand(self, node, attrs, ins):
+        shape = tuple(int(s) for s in self.const(node["input"][1]))
+        self.set_out(node, [self.sym().broadcast_to(
+            ins[0], shape=shape, name=self._name(node))])
+
+    def op_Where(self, node, attrs, ins):
+        self.set_out(node, [self.sym().where(
+            *ins, name=self._name(node))])
+
+    def op_Greater(self, node, attrs, ins):
+        self._binary(node, ins, "broadcast_greater")
+
+    def op_Less(self, node, attrs, ins):
+        self._binary(node, ins, "broadcast_lesser")
+
+    def op_Equal(self, node, attrs, ins):
+        self._binary(node, ins, "broadcast_equal")
+
+    def op_Not(self, node, attrs, ins):
+        self.set_out(node, [self.sym().logical_not(
+            ins[0], name=self._name(node))])
+
+    def op_Slice(self, node, attrs, ins):
+        names = node["input"]
+        if len(names) >= 3:  # opset 10+: starts/ends[/axes[/steps]] inputs
+            starts = [int(v) for v in self.const(names[1])]
+            ends = [int(v) for v in self.const(names[2])]
+            # axes/steps are optional; "" is the empty-placeholder form
+            if len(names) >= 4 and names[3]:
+                axes = [int(v) for v in self.const(names[3])]
+            else:
+                axes = list(range(len(starts)))
+            if len(names) >= 5 and names[4]:
+                steps = [int(v) for v in self.const(names[4])]
+                if any(s != 1 for s in steps):
+                    raise MXNetError("ONNX import: strided Slice")
+        else:  # opset <10: attributes
+            starts = [int(v) for v in attrs["starts"]]
+            ends = [int(v) for v in attrs["ends"]]
+            axes = [int(v) for v in attrs.get("axes",
+                                              range(len(starts)))]
+        out = ins[0]
+        S = self.sym()
+        big = 1 << 60
+        for ax, b, e in zip(axes, starts, ends):
+            out = S.slice_axis(out, axis=ax, begin=b,
+                               end=None if e >= big else e)
+        self.set_out(node, [out])
 
     def _pool(self, node, attrs, ins, pool_type, global_pool=False):
         kw = dict(pool_type=pool_type, global_pool=global_pool,

@@ -385,7 +385,10 @@ class Symbol:
         for node, idx in self._entries:
             lst = node_out.get(id(node), [None])
             out_structs.append(lst[idx] if idx < len(lst) else None)
-        return {"vars": var_struct, "outs": out_structs}
+        # "nodes": per-node output structs keyed by id(node) — consumers
+        # like the ONNX exporter need intermediate shapes/dtypes, not just
+        # the graph boundary
+        return {"vars": var_struct, "outs": out_structs, "nodes": node_out}
 
     # -- graph passes ------------------------------------------------------
     def optimize_for(self, backend: str, args=None, aux=None, **kwargs):

@@ -185,10 +185,10 @@ def test_fix_gamma_exported_as_ones():
 
 def test_unsupported_op_raises_with_name():
     x = sym.Variable("x")
-    s = sym.Embedding(x, input_dim=4, output_dim=2, name="emb")
-    with pytest.raises(MXNetError, match="Embedding"):
-        onnx_mxnet.export_model(s, _fill_params(s, {"x": (2,)}),
-                                [(2,)], np.float32,
+    s = sym.Deconvolution(x, kernel=(2, 2), num_filter=2, name="dc")
+    with pytest.raises(MXNetError, match="Deconvolution"):
+        onnx_mxnet.export_model(s, _fill_params(s, {"x": (1, 3, 4, 4)}),
+                                [(1, 3, 4, 4)], np.float32,
                                 os.path.join(tempfile.mkdtemp(), "m.onnx"))
 
 
@@ -218,6 +218,61 @@ def test_import_to_gluon():
         net = onnx_mxnet.import_to_gluon(path)
     y = net(nd.array(feeds["data"])).asnumpy()
     np.testing.assert_allclose(y, y_ref, atol=1e-6)
+
+
+def test_mini_transformer_roundtrip():
+    """Transformer-family ops through real ONNX: Embedding->Gather (int32
+    graph input, params keep float32), LayerNorm decomposition, per-
+    position FC (MatMul path), batch_dot with transpose_b, scaled softmax,
+    slice_axis, reduction."""
+    V, D, T, B = 16, 8, 6, 2
+    tokens = sym.Variable("tokens", dtype="int32")
+    emb = sym.Embedding(tokens, input_dim=V, output_dim=D, name="emb")
+    ln = sym.LayerNorm(emb, name="ln")
+    q = sym.FullyConnected(ln, num_hidden=D, flatten=False, name="q")
+    k = sym.FullyConnected(ln, num_hidden=D, flatten=False, name="k")
+    v = sym.FullyConnected(ln, num_hidden=D, flatten=False, name="v")
+    scores = sym.batch_dot(q, k, transpose_b=True, name="scores")
+    att = sym.softmax(scores * (1.0 / np.sqrt(D)), axis=-1, name="att")
+    ctxv = sym.batch_dot(att, v, name="ctx")
+    first = sym.slice_axis(ctxv, axis=1, begin=0, end=3, name="sl")
+    s = sym.sum(first, axis=-1, keepdims=False, name="out")
+
+    rng = np.random.RandomState(0)
+    shapes, _, _ = s.infer_shape(tokens=(B, T))
+    params = {}
+    for name, shp in zip(s.list_arguments(), shapes):
+        if name == "tokens":
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype("float32") * 0.3)
+    tok = rng.randint(0, V, (B, T)).astype("int32")
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.onnx")
+        onnx_mxnet.export_model(s, params, [(B, T)], np.int32, path)
+        meta = onnx_mxnet.get_model_metadata(path)
+        assert meta["input_tensor_data"] == [("tokens", (B, T))]
+        sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+
+    def fwd(S, pr):
+        ex = S.simple_bind(ctx=mx.cpu(), tokens=(B, T))
+        for kk, vv in pr.items():
+            (ex.aux_dict if kk in ex.aux_dict else ex.arg_dict)[kk][:] = vv
+        ex.arg_dict["tokens"][:] = nd.array(tok, dtype="int32")
+        return ex.forward(is_train=False)[0].asnumpy()
+
+    y1, y2 = fwd(s, params), fwd(sym2, {**arg2, **aux2})
+    np.testing.assert_allclose(y1, y2, atol=1e-5, rtol=1e-5)
+
+
+def test_where_broadcast_axis_expand_dims_roundtrip():
+    x = sym.Variable("x")
+    m = sym.expand_dims(x, axis=1, name="ed")          # (B,1,C)
+    bcast = sym.broadcast_axis(m, axis=1, size=3, name="ba")  # (B,3,C)
+    cond = sym._greater_scalar(bcast, scalar=0.0)
+    s = sym.where(cond, bcast, bcast * 0.1, name="out")
+    feeds = {"x": np.random.RandomState(8).randn(2, 4).astype("float32")}
+    _roundtrip(s, {}, feeds)
 
 
 @pytest.mark.slow
