@@ -37,7 +37,9 @@ class DetAugmenter:
     def dumps(self):
         import json
 
-        return json.dumps([type(self).__name__, self._kwargs])
+        return json.dumps([type(self).__name__, self._kwargs],
+                          default=lambda o: o.tolist()
+                          if isinstance(o, np.ndarray) else str(o))
 
     def __call__(self, src, label):
         raise NotImplementedError

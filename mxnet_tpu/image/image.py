@@ -156,7 +156,11 @@ class Augmenter:
     def dumps(self):
         import json
 
-        return json.dumps([type(self).__name__, self._kwargs])
+        # mean/std kwargs are ndarrays (reference: image.py Augmenter.dumps
+        # converts them via tolist())
+        return json.dumps([type(self).__name__, self._kwargs],
+                          default=lambda o: o.tolist()
+                          if isinstance(o, np.ndarray) else str(o))
 
     def __call__(self, src):
         raise NotImplementedError

@@ -80,6 +80,20 @@ def test_train_detection_e2e():
     assert "faster_rcnn: loss" in res.stdout, res.stdout[-500:]
 
 
+def test_train_detection_recordio_e2e():
+    """BASELINE config-5 acceptance shape: detection RecordIO ->
+    ImageDetIter (bbox-aware augmentation) -> SSD train step."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples",
+                                      "train_detection.py"),
+         "--device", "cpu", "--model", "ssd", "--make-rec", "16",
+         "--steps", "4", "--image-size", "64", "--batch-size", "2"],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "synthesized 16-image det RecordIO" in res.stdout
+    assert "ssd: loss" in res.stdout, res.stdout[-500:]
+
+
 def test_bert_pretrain_3d_e2e():
     """3D-parallel (dp2 x pp2 x tp2) BERT pretrain example on the virtual
     mesh (slow tier)."""
