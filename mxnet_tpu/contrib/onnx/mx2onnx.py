@@ -176,6 +176,21 @@ def _leaky(ctx, node, ins, outs, attrs):
         ctx.add_node("Elu", ins, outs, name=node.name, alpha=slope)
     elif act == "prelu":
         ctx.add_node("PRelu", ins, outs, name=node.name)
+    elif act == "gelu":
+        # exact gelu: 0.5 * x * (1 + erf(x / sqrt(2))) — no Gelu op in
+        # opset 11
+        s = ctx.in_struct(node, 0)
+        dt = None if s is None else s.dtype
+        t = lambda: ctx.tmp(node.name)  # noqa: E731
+        div, erf, one, mul = t(), t(), t(), t()
+        ctx.add_node("Div", [ins[0], ctx.scalar(np.sqrt(2.0), node.name,
+                                                dtype=dt)], [div])
+        ctx.add_node("Erf", [div], [erf])
+        ctx.add_node("Add", [erf, ctx.scalar(1.0, node.name, dtype=dt)],
+                     [one])
+        ctx.add_node("Mul", [ins[0], one], [mul])
+        ctx.add_node("Mul", [mul, ctx.scalar(0.5, node.name, dtype=dt)],
+                     outs, name=node.name)
     else:
         raise MXNetError(f"ONNX export: LeakyReLU act_type={act!r}")
 
@@ -304,8 +319,15 @@ def _concat(ctx, node, ins, outs, attrs):
 def _reshape(ctx, node, ins, outs, attrs):
     shape = [int(s) for s in attrs.get("shape", ())]
     if any(s < -1 for s in shape):
-        raise MXNetError("ONNX export: Reshape special codes -2/-3/-4 have "
-                         "no ONNX equivalent; use explicit dims")
+        # MXNet's -2/-3/-4 split/merge codes have no ONNX encoding, but
+        # under export the shapes are static — emit the node's inferred
+        # output shape instead
+        lst = ctx.structs.get(id(node))
+        if not lst or lst[0] is None:
+            raise MXNetError(
+                "ONNX export: Reshape special codes -2/-3/-4 need shape "
+                "inference (failed upstream); use explicit dims")
+        shape = [int(d) for d in lst[0].shape]
     shp = ctx.add_initializer(f"{node.name}_shape",
                               np.asarray(shape, dtype=np.int64))
     ctx.add_node("Reshape", [ins[0], shp], outs, name=node.name)
@@ -512,6 +534,67 @@ def _where(ctx, node, ins, outs, attrs):
     cond = ctx.tmp(node.name)
     ctx.add_node("Cast", [ins[0]], [cond], to=P.BOOL)
     ctx.add_node("Where", [cond, ins[1], ins[2]], outs, name=node.name)
+
+
+@_register("slice_like")
+def _slice_like(ctx, node, ins, outs, attrs):
+    # static export: slice input 0 on `axes` down to input 1's inferred
+    # dims (dynamic-shape slice_like would need Shape ops; export shapes
+    # are fixed, so the static Slice is exact)
+    like = ctx.in_struct(node, 1)
+    src = ctx.in_struct(node, 0)
+    if like is None or src is None:
+        raise MXNetError("ONNX export: slice_like needs shape inference")
+    axes = [int(a) for a in (attrs.get("axes") or
+                             range(min(len(src.shape), len(like.shape))))]
+    starts = [0] * len(axes)
+    ends = [int(like.shape[a]) for a in axes]
+    s = ctx.add_initializer(f"{node.name}_starts",
+                            np.asarray(starts, np.int64))
+    e = ctx.add_initializer(f"{node.name}_ends",
+                            np.asarray(ends, np.int64))
+    a = ctx.add_initializer(f"{node.name}_axes",
+                            np.asarray(axes, np.int64))
+    ctx.add_node("Slice", [ins[0], s, e, a], outs, name=node.name)
+
+
+@_register("_contrib_flash_attention")
+def _flash_attention(ctx, node, ins, outs, attrs):
+    """Dense decomposition: softmax(q k^T * sm_scale [+ causal mask]) v —
+    numerically the attention the fused Pallas kernel computes
+    (ops/pallas/flash_attention.py), expressed in plain ONNX ops."""
+    q, k, v = ins
+    qs = ctx.in_struct(node, 0)
+    ks = ctx.in_struct(node, 1)
+    if qs is None or ks is None:
+        raise MXNetError("ONNX export: flash_attention needs shape "
+                         "inference")
+    rank = len(qs.shape)
+    head_dim = int(qs.shape[-1])
+    scale = attrs.get("sm_scale") or 1.0 / np.sqrt(head_dim)
+    dt = qs.dtype
+    t = lambda: ctx.tmp(node.name)  # noqa: E731
+    perm = list(range(rank))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    kt, sc, scaled = t(), t(), t()
+    ctx.add_node("Transpose", [k], [kt], perm=perm)
+    ctx.add_node("MatMul", [q, kt], [sc])
+    ctx.add_node("Mul", [sc, ctx.scalar(float(scale), node.name,
+                                        dtype=dt)], [scaled])
+    if attrs.get("causal", False):
+        # (Lq, Lk) mask matching the kernel's qpos >= kpos rule — q and
+        # k/v sequence lengths may differ (decode steps)
+        lq, lk = int(qs.shape[-2]), int(ks.shape[-2])
+        mask = ctx.add_initializer(
+            f"{node.name}_causal",
+            np.triu(np.full((lq, lk), -1e9 if np.dtype(dt).itemsize > 2
+                            else -3e4, dtype=dt), k=1))
+        masked = t()
+        ctx.add_node("Add", [scaled, mask], [masked])
+        scaled = masked
+    att = t()
+    ctx.add_node("Softmax", [scaled], [att], axis=-1)
+    ctx.add_node("MatMul", [att, v], outs, name=node.name)
 
 
 @_register("slice_axis")

@@ -159,9 +159,10 @@ class PositionalEmbedding(HybridBlock):
             self.weight = self.params.get("weight", shape=(max_length, units))
 
     def hybrid_forward(self, F, x, weight):
-        # x: (B, T, C); add positions [0, T)
-        T = x.shape[1]
-        return x + F.slice_axis(weight, axis=0, begin=0, end=T).expand_dims(0)
+        # x: (B, T, C); add positions [0, T).  slice_like instead of
+        # .shape keeps the block Symbol-traceable (export / SymbolBlock)
+        pos = F.slice_like(F.expand_dims(weight, axis=0), x, axes=(1,))
+        return F.broadcast_add(x, pos)
 
 
 class TransformerEncoder(HybridBlock):

@@ -234,6 +234,16 @@ class NDArray:
         return key
 
     def __getitem__(self, key) -> "NDArray":
+        # bounds-check plain int indices: jax clamps out-of-range gathers,
+        # which would make Python's legacy iteration protocol (used when a
+        # caller iterates an NDArray) spin forever instead of stopping at
+        # IndexError (reference: ndarray.py __getitem__ raises)
+        if (isinstance(key, (int, np.integer))
+                and not isinstance(key, (bool, np.bool_))):
+            n = self.shape[0] if self.shape else 0
+            if not -n <= key < n:
+                raise IndexError(
+                    f"index {key} is out of bounds for axis 0 with size {n}")
         k = self._convert_key(key)
         return _reg.invoke_fn(lambda x: x[k], [self])
 

@@ -503,7 +503,12 @@ class Symbol:
     def __neg__(self): return _apply_sym("_mul_scalar", [self], {"scalar": -1.0})
 
     # common method forms
-    def reshape(self, shape): return _apply_sym("Reshape", [self], {"shape": tuple(shape)})
+    def reshape(self, *shape):
+        # both spellings, like NDArray.reshape: s.reshape((a, b)) and
+        # s.reshape(a, b) — hybrid_forward code uses either
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _apply_sym("Reshape", [self], {"shape": tuple(shape)})
     def transpose(self, axes=()): return _apply_sym("transpose", [self], {"axes": tuple(axes)})
     def astype(self, dtype): return _apply_sym("Cast", [self], {"dtype": str(np.dtype(dtype))})
     def sum(self, axis=None, keepdims=False):

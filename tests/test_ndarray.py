@@ -67,6 +67,22 @@ def test_indexing():
     assert x.asnumpy()[0, 0, 0] == 99
 
 
+def test_iteration_protocol():
+    """Plain-int indexing bounds-checks (jax clamps OOB gathers, which
+    would make Python's legacy iteration spin forever), iteration yields
+    first-dim rows, negative indices still work (reference: NDArray
+    __getitem__ raises IndexError out of range)."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    rows = [r.asnumpy() for r in x]
+    assert len(rows) == 2
+    np.testing.assert_array_equal(rows[1], [3, 4, 5])
+    with pytest.raises(IndexError):
+        x[2]
+    with pytest.raises(IndexError):
+        x[-3]
+    np.testing.assert_array_equal(x[-1].asnumpy(), [3, 4, 5])
+
+
 def test_comparison():
     a = nd.array([1.0, 2.0, 3.0])
     b = nd.array([2.0, 2.0, 2.0])

@@ -275,6 +275,34 @@ def test_where_broadcast_axis_expand_dims_roundtrip():
     _roundtrip(s, {}, feeds)
 
 
+def test_bert_small_roundtrip():
+    """Full BERT (our flagship family) through real ONNX: the traced
+    graph contains Embedding, slice_like (position table), LayerNorm,
+    per-position FCs, split-heads Reshapes with -4 codes, Pallas
+    _contrib_flash_attention (exported as its dense decomposition), and
+    gelu (Erf decomposition) — all at static export shapes."""
+    import mxnet_tpu as mx2
+    from mxnet_tpu.models import bert_small
+
+    net = bert_small()
+    net.initialize(mx2.init.Normal(0.02))
+    tok = np.random.RandomState(0).randint(0, 512, (2, 12)).astype("int32")
+    y_ref = net(nd.array(tok, dtype="int32")).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        net.export(os.path.join(d, "bert"))
+        path = onnx_mxnet.export_model(
+            os.path.join(d, "bert-symbol.json"),
+            os.path.join(d, "bert-0000.params"),
+            [(2, 12)], np.int32, os.path.join(d, "bert.onnx"))
+        sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    ex = sym2.simple_bind(ctx=mx.cpu(), data=(2, 12))
+    for kk, vv in {**arg2, **aux2}.items():
+        (ex.aux_dict if kk in ex.aux_dict else ex.arg_dict)[kk][:] = vv
+    ex.arg_dict["data"][:] = nd.array(tok, dtype="int32")
+    y2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y_ref, y2, atol=2e-5, rtol=1e-4)
+
+
 @pytest.mark.slow
 def test_resnet18_roundtrip():
     from mxnet_tpu.gluon.model_zoo import vision
