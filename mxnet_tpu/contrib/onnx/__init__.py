@@ -52,11 +52,15 @@ def export_model(sym, params, input_shape: Sequence[Tuple[int, ...]],
 
     Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py ~L1-100
     (same signature: `sym`/`params` may be objects or file paths;
-    `input_shape` is a list of tuples, one per data input).
+    `input_shape` is a list of tuples, one per data input, in the graph's
+    list_arguments order — or, unambiguously for multi-input graphs, a
+    dict {input_name: shape}).
     """
     sym = _load_symbol(sym)
     params = _load_params(params)
-    model_bytes = export_symbol(sym, params, list(input_shape),
+    shapes = (dict(input_shape) if isinstance(input_shape, dict)
+              else list(input_shape))
+    model_bytes = export_symbol(sym, params, shapes,
                                 input_dtype=input_type)
     with open(onnx_file_path, "wb") as f:
         f.write(model_bytes)

@@ -506,7 +506,8 @@ _CMP_SCALAR = {"_greater_scalar": ("Greater", False),
                "_lesser_scalar": ("Less", False),
                "_greater_equal_scalar": ("Less", True),
                "_lesser_equal_scalar": ("Greater", True),
-               "_equal_scalar": ("Equal", False)}
+               "_equal_scalar": ("Equal", False),
+               "_not_equal_scalar": ("Equal", True)}
 
 
 @_register(*_CMP_SCALAR)
@@ -534,6 +535,63 @@ def _where(ctx, node, ins, outs, attrs):
     cond = ctx.tmp(node.name)
     ctx.add_node("Cast", [ins[0]], [cond], to=P.BOOL)
     ctx.add_node("Where", [cond, ins[1], ins[2]], outs, name=node.name)
+
+
+@_register("broadcast_like")
+def _broadcast_like(ctx, node, ins, outs, attrs):
+    # static export: Expand to the node's inferred output shape
+    lst = ctx.structs.get(id(node))
+    if not lst or lst[0] is None:
+        raise MXNetError("ONNX export: broadcast_like needs shape "
+                         "inference for its Expand target")
+    shp = ctx.add_initializer(f"{node.name}_target",
+                              np.asarray(lst[0].shape, dtype=np.int64))
+    ctx.add_node("Expand", [ins[0], shp], outs, name=node.name)
+
+
+@_register("ones_like", "zeros_like")
+def _fill_like(ctx, node, ins, outs, attrs):
+    # shape- and dtype-preserving without materializing a constant tensor:
+    # zeros = x * 0, ones = x * 0 + 1
+    s = ctx.in_struct(node, 0)
+    if s is None:  # a dtype-blind constant would mismatch int inputs
+        raise MXNetError(f"ONNX export: {node.op} needs dtype inference")
+    dt = s.dtype
+    zeros = ctx.tmp(node.name) if node.op == "ones_like" else outs[0]
+    ctx.add_node("Mul", [ins[0], ctx.scalar(0.0, node.name, dtype=dt)],
+                 [zeros], name=node.name if node.op == "zeros_like" else "")
+    if node.op == "ones_like":
+        ctx.add_node("Add", [zeros, ctx.scalar(1.0, node.name, dtype=dt)],
+                     outs, name=node.name)
+
+
+@_register("cumsum")
+def _cumsum(ctx, node, ins, outs, attrs):
+    axis = attrs.get("axis", None)
+    if axis is None:
+        raise MXNetError("ONNX export: cumsum over the flattened array "
+                         "(axis=None) unsupported; pass an axis")
+    ax = ctx.add_initializer(f"{node.name}_axis",
+                             np.asarray(int(axis), dtype=np.int64))
+    ctx.add_node("CumSum", [ins[0], ax], outs, name=node.name)
+
+
+@_register("linalg_makediag")
+def _makediag(ctx, node, ins, outs, attrs):
+    # diag(v)[i, j] = v[i] * eye[i, j]; eye is a static initializer
+    # (export shapes are fixed), so the translation is one Unsqueeze+Mul
+    if int(attrs.get("offset", 0)) != 0:
+        raise MXNetError("ONNX export: linalg_makediag offset != 0")
+    s = ctx.in_struct(node, 0)
+    if s is None or len(s.shape) != 1:
+        raise MXNetError("ONNX export: linalg_makediag needs a known "
+                         "1-D input shape")
+    n = int(s.shape[0])
+    eye = ctx.add_initializer(f"{node.name}_eye",
+                              np.eye(n, dtype=s.dtype))
+    col = ctx.tmp(node.name)
+    ctx.add_node("Unsqueeze", [ins[0]], [col], axes=[1])
+    ctx.add_node("Mul", [col, eye], outs, name=node.name)
 
 
 @_register("slice_like")
@@ -658,18 +716,29 @@ def export_symbol(sym, params: Dict[str, np.ndarray],
     order = _topo_order(sym._entries)
     free_inputs = [n for n in order
                    if n.is_variable() and n.name not in params]
-    if len(free_inputs) != len(input_shapes):
-        raise MXNetError(
-            f"export_model: graph has {len(free_inputs)} data inputs "
-            f"({[n.name for n in free_inputs]}) but {len(input_shapes)} "
-            "input shapes were given")
+    if isinstance(input_shapes, dict):
+        missing = [n.name for n in free_inputs if n.name not in input_shapes]
+        if missing:
+            raise MXNetError(
+                f"export_model: input shapes missing for {missing}")
+        shape_kwargs = {n.name: tuple(input_shapes[n.name])
+                        for n in free_inputs}
+    else:
+        # positional list: graph (topo/list_arguments) order — for multi-
+        # input graphs that order is traversal-dependent, so a dict
+        # {input_name: shape} is the unambiguous spelling
+        if len(free_inputs) != len(input_shapes):
+            raise MXNetError(
+                f"export_model: graph has {len(free_inputs)} data inputs "
+                f"({[n.name for n in free_inputs]}) but {len(input_shapes)}"
+                " input shapes were given")
+        shape_kwargs = {n.name: tuple(s)
+                        for n, s in zip(free_inputs, input_shapes)}
 
     # graph-wide shape/dtype inference: per-node structs let translators
     # that need rank/dtype (batch_dot transposes, Embedding index casts,
     # broadcast_axis target shapes) emit correct graphs, and give every
     # graph input/output its real elem_type
-    shape_kwargs = {n.name: tuple(s)
-                    for n, s in zip(free_inputs, input_shapes)}
     try:
         structs = sym._infer_structs(
             shapes=shape_kwargs,
@@ -679,7 +748,13 @@ def export_symbol(sym, params: Dict[str, np.ndarray],
         ctx.structs = structs["nodes"]
         var_structs = structs["vars"]
         out_structs = structs["outs"]
-    except Exception:
+    except Exception as e:
+        # degraded export: rank/dtype-dependent translators will raise if
+        # reached — surface why instead of failing there mysteriously
+        import warnings
+
+        warnings.warn(f"ONNX export: graph shape inference failed ({e}); "
+                      "exporting without per-node shape info")
         var_structs = {}
         out_structs = [None] * len(sym._entries)
 

@@ -151,6 +151,14 @@ class _Importer:
         self.set_out(node, [self.sym().logical_not(
             ins[0], name=self._name(node))])
 
+    def op_CumSum(self, node, attrs, ins):
+        if attrs.get("exclusive") or attrs.get("reverse"):
+            raise MXNetError("ONNX import: CumSum exclusive/reverse "
+                             "unsupported")
+        axis = int(np.asarray(self.const(node["input"][1])).flat[0])
+        self.set_out(node, [self.sym().cumsum(
+            ins[0], axis=axis, name=self._name(node))])
+
     def op_Slice(self, node, attrs, ins):
         names = node["input"]
         if len(names) >= 3:  # opset 10+: starts/ends[/axes[/steps]] inputs

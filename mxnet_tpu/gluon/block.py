@@ -481,16 +481,16 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path: str, epoch: int = 0):
+    def export(self, path: str, epoch: int = 0, input_names=("data",)):
         """Emit {path}-symbol.json + {path}-{epoch:04d}.params (reference:
         gluon/block.py export ~L900): trace hybrid_forward with Symbol
         proxies, then save parameters keyed arg:/aux: by graph role, so
-        SymbolBlock.imports / Module.load round-trip."""
+        SymbolBlock.imports / Module.load round-trip.  Multi-input blocks
+        (seq2seq src/tgt, ...) pass their input names via `input_names`."""
         from .. import symbol as _sym
         from ..ndarray import save as nd_save
 
-        data = _sym.var("data")
-        out = self(data)
+        out = self(*[_sym.var(n) for n in input_names])
         if isinstance(out, (list, tuple)):
             out = _sym.Group(out)
         out.save(f"{path}-symbol.json")

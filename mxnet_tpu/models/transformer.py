@@ -43,7 +43,11 @@ def _merge_heads(t, num_heads):
 def _mask_scores(F, scores, mask, num_heads):
     """mask: (B, Tq, Tk) with 1=keep, broadcast over heads of (B*H, Tq, Tk)
     scores; masked-out positions get the dtype-safe big negative."""
-    big_neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
+    # Symbols carry no host-side dtype — use the half-safe -3e4 for the
+    # trace (exact-0 softmax weight in f32 too, and an fp16/bf16 export
+    # of the traced graph stays finite where -1e9 would overflow to -inf)
+    dt = getattr(scores, "dtype", None)
+    big_neg = -1e9 if (dt is not None and "16" not in str(dt)) else -3e4
     m = mask.expand_dims(1)
     m = F.broadcast_like(m, scores.reshape(-4, -1, num_heads, 0, 0),
                          lhs_axes=(1,), rhs_axes=(1,))
@@ -92,14 +96,32 @@ class MultiHeadAttention(HybridBlock):
             return self.proj(_merge_heads(out, self._num_heads))
         scores = F.batch_dot(q, k, transpose_b=True) / math.sqrt(self._head_dim)
         if self._causal:
-            T = scores.shape[-1]
-            neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
-            # constant built host-side IN the score dtype: an f32 addend
-            # would silently promote the whole bf16 attention chain to f32
-            addend = F.array(
-                np.triu(np.full((T, T), neg, dtype_np(scores.dtype)), k=1),
-                ctx=scores.context, dtype=dtype_np(scores.dtype))
-            scores = F.broadcast_add(scores, addend.expand_dims(0))
+            if hasattr(scores, "shape"):  # eager / CachedOp tracer
+                T = scores.shape[-1]
+                neg = -1e9 if str(scores.dtype).find("16") < 0 else -3e4
+                # constant built host-side IN the score dtype: an f32
+                # addend would silently promote the whole bf16 attention
+                # chain to f32
+                addend = F.array(
+                    np.triu(np.full((T, T), neg, dtype_np(scores.dtype)),
+                            k=1),
+                    ctx=scores.context, dtype=dtype_np(scores.dtype))
+                scores = F.broadcast_add(scores, addend.expand_dims(0))
+            else:
+                # Symbol trace (export): no host-side T — build the tril
+                # keep-mask from ops.  cumsum(identity, axis=0)[i, j] is
+                # 1 iff i >= j, the causal rule (self-attention: Tq == Tk)
+                ones_k = F.Reshape(
+                    F.slice_axis(F.slice_axis(F.ones_like(scores), axis=0,
+                                              begin=0, end=1),
+                                 axis=1, begin=0, end=1), shape=(-1,))
+                keep = F.cumsum(F.linalg_makediag(ones_k), axis=0)
+                keep = F.broadcast_like(keep.expand_dims(0), scores,
+                                        lhs_axes=(0,), rhs_axes=(0,))
+                # -3e4, not -1e9: still exactly 0 after f32 softmax, and
+                # finite if the traced graph is exported/cast to 16-bit
+                scores = F.where(keep, scores,
+                                 F.ones_like(scores) * -3e4)
         if mask is not None:
             scores = _mask_scores(F, scores, mask, self._num_heads)
         attn = F.softmax(scores, axis=-1)
@@ -356,29 +378,35 @@ class Transformer(HybridBlock):
         1 = attend.  Causality is NOT folded into masks: the decoder's
         self-attention block is constructed causal=True and applies the
         tril itself."""
-        Ts = src.shape[1]
-        src_keep = (src != self._pad_id)  # (B, Ts)
-        enc_mask = F.broadcast_axis(src_keep.expand_dims(1), axis=1, size=Ts)
+        # not_equal / broadcast_like instead of `!=` + .shape so the block
+        # stays Symbol-traceable (export / ONNX); same numerics in eager
+        src_keep = F.not_equal(src, self._pad_id)  # (B, Ts)
+        enc_mask = F.broadcast_like(src_keep.expand_dims(1), src,
+                                    lhs_axes=(1,), rhs_axes=(1,))
         mem = self.embed(src) * math.sqrt(self._units)
         mem = self.enc_drop(self.pos(mem))
         return self.encoder(mem, enc_mask), src_keep
 
     def _decode_h(self, F, tgt, mem, src_keep):
-        Tt = tgt.shape[1]
-        cross_mask = F.broadcast_axis(src_keep.expand_dims(1), axis=1,
-                                      size=Tt)  # (B, Tt, Ts)
-        self_mask = F.broadcast_axis((tgt != self._pad_id).expand_dims(1),
-                                     axis=1, size=Tt)  # (B, Tt, Tt)
+        cross_mask = F.broadcast_like(src_keep.expand_dims(1), tgt,
+                                      lhs_axes=(1,), rhs_axes=(1,))
+        self_mask = F.broadcast_like(
+            F.not_equal(tgt, self._pad_id).expand_dims(1), tgt,
+            lhs_axes=(1,), rhs_axes=(1,))  # (B, Tt, Tt)
         h = self.embed(tgt) * math.sqrt(self._units)
         h = self.enc_drop(self.pos(h))
         h = self.decoder(h, mem, self_mask, cross_mask)
         if self._tie:
-            # tied softmax: logits = h E^T (shared embedding matrix)
-            return F.FullyConnected(h.reshape(-3, 0),
-                                    self.embed.weight.data(h.context),
-                                    num_hidden=self._vocab, no_bias=True,
-                                    flatten=False).reshape(
-                                        -4, -1, Tt, 0)
+            # tied softmax: logits = h E^T (shared embedding matrix);
+            # flatten=False projects per position on the rank-3 input
+            # directly.  Under Symbol tracing the shared weight enters as
+            # its parameter variable (NDArrays cannot join a symbol graph)
+            if hasattr(h, "context"):
+                w = self.embed.weight.data(h.context)
+            else:
+                w = self.embed.weight.var()
+            return F.FullyConnected(h, w, num_hidden=self._vocab,
+                                    no_bias=True, flatten=False)
         return self.out_proj(h)
 
     def hybrid_forward(self, F, src, tgt):

@@ -233,17 +233,30 @@ class NDArray:
             )
         return key
 
+    def _check_int_bounds(self, key) -> None:
+        """Bounds-check int indices (bare or inside a tuple): jax clamps
+        out-of-range gathers, which would make Python's legacy iteration
+        protocol spin forever and silently alias OOB element access
+        (reference: ndarray.py __getitem__ raises IndexError)."""
+        def is_int(k):
+            return (isinstance(k, (int, np.integer))
+                    and not isinstance(k, (bool, np.bool_)))
+
+        if is_int(key):
+            entries = [(0, key)]
+        elif (isinstance(key, tuple) and Ellipsis not in key
+                and not any(k is None for k in key)):  # None shifts axes
+            entries = [(ax, k) for ax, k in enumerate(key) if is_int(k)]
+        else:
+            return
+        for ax, k in entries:
+            n = self.shape[ax] if ax < len(self.shape) else 0
+            if not -n <= k < n:
+                raise IndexError(f"index {k} is out of bounds for axis "
+                                 f"{ax} with size {n}")
+
     def __getitem__(self, key) -> "NDArray":
-        # bounds-check plain int indices: jax clamps out-of-range gathers,
-        # which would make Python's legacy iteration protocol (used when a
-        # caller iterates an NDArray) spin forever instead of stopping at
-        # IndexError (reference: ndarray.py __getitem__ raises)
-        if (isinstance(key, (int, np.integer))
-                and not isinstance(key, (bool, np.bool_))):
-            n = self.shape[0] if self.shape else 0
-            if not -n <= key < n:
-                raise IndexError(
-                    f"index {key} is out of bounds for axis 0 with size {n}")
+        self._check_int_bounds(key)
         k = self._convert_key(key)
         return _reg.invoke_fn(lambda x: x[k], [self])
 

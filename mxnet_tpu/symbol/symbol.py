@@ -511,6 +511,16 @@ class Symbol:
         return _apply_sym("Reshape", [self], {"shape": tuple(shape)})
     def transpose(self, axes=()): return _apply_sym("transpose", [self], {"axes": tuple(axes)})
     def astype(self, dtype): return _apply_sym("Cast", [self], {"dtype": str(np.dtype(dtype))})
+    def expand_dims(self, axis):
+        return _apply_sym("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _apply_sym("squeeze", [self],
+                          {} if axis is None else {"axis": axis})
+
+    def flatten(self):
+        return _apply_sym("Flatten", [self], {})
+
     def sum(self, axis=None, keepdims=False):
         return _apply_sym("sum", [self], {"axis": axis, "keepdims": keepdims})
     def mean(self, axis=None, keepdims=False):

@@ -80,7 +80,12 @@ def test_iteration_protocol():
         x[2]
     with pytest.raises(IndexError):
         x[-3]
+    with pytest.raises(IndexError):
+        x[5, 0]  # int inside a tuple key, any axis
+    with pytest.raises(IndexError):
+        x[0, 7]
     np.testing.assert_array_equal(x[-1].asnumpy(), [3, 4, 5])
+    np.testing.assert_array_equal(x[1, 2].asnumpy(), 5)
 
 
 def test_comparison():
