@@ -102,20 +102,38 @@ def _register(*op_names):
 # --------------------------------------------------------------------------
 
 
-@_register("Convolution")
-def _conv(ctx, node, ins, outs, attrs):
+def _conv_common(op, attrs):
+    """Shared (De)Convolution attr extraction + channel-first guard."""
     if attrs.get("layout") not in (None, "NCHW", "NCW", "NCDHW"):
-        raise MXNetError("ONNX export supports channel-first Convolution "
-                         f"only, got layout={attrs['layout']!r}")
+        raise MXNetError(f"ONNX export supports channel-first {op} only, "
+                         f"got layout={attrs['layout']!r}")
     kernel = [int(k) for k in attrs.get("kernel", ())]
     ndim = len(kernel)
-    ctx.add_node(
-        "Conv", ins, outs, name=node.name,
-        kernel_shape=kernel,
-        strides=_pair(attrs, "stride", ndim, 1),
-        dilations=_pair(attrs, "dilate", ndim, 1),
-        pads=_pads(_pair(attrs, "pad", ndim, 0)),
-        group=int(attrs.get("num_group", 1)))
+    return dict(kernel_shape=kernel,
+                strides=_pair(attrs, "stride", ndim, 1),
+                dilations=_pair(attrs, "dilate", ndim, 1),
+                pads=_pads(_pair(attrs, "pad", ndim, 0)),
+                group=int(attrs.get("num_group", 1)))
+
+
+@_register("Convolution")
+def _conv(ctx, node, ins, outs, attrs):
+    ctx.add_node("Conv", ins, outs, name=node.name,
+                 **_conv_common("Convolution", attrs))
+
+
+@_register("Deconvolution")
+def _deconv(ctx, node, ins, outs, attrs):
+    # transposed conv: MXNet weight layout (C_in, C_out/group, *k) is
+    # exactly ONNX ConvTranspose's W layout
+    if attrs.get("target_shape"):
+        raise MXNetError("ONNX export: Deconvolution target_shape "
+                         "unsupported (use adj/output_padding)")
+    kw = _conv_common("Deconvolution", attrs)
+    adj = [int(a) for a in attrs.get("adj", ())]
+    if any(adj):
+        kw["output_padding"] = adj
+    ctx.add_node("ConvTranspose", ins, outs, name=node.name, **kw)
 
 
 @_register("BatchNorm")

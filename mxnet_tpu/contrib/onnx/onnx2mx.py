@@ -74,6 +74,7 @@ class _Importer:
         fn(node, node["attrs"], [self.get(i) for i in node["input"] if i])
 
     def op_Conv(self, node, attrs, ins):
+        self._check_auto_pad(node, attrs)
         pads = attrs.get("pads")
         kernel = attrs["kernel_shape"]
         ndim = len(kernel)
@@ -95,6 +96,39 @@ class _Importer:
             return self.init[name].shape
         raise MXNetError(f"ONNX import: weight {name!r} must be an "
                          "initializer to infer its layer config")
+
+    @staticmethod
+    def _check_auto_pad(node, attrs):
+        # SAME_UPPER/SAME_LOWER carry no pads attr; importing them as
+        # pad=0 would be silently wrong
+        if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
+            raise MXNetError(
+                f"ONNX import: {node['op_type']} "
+                f"auto_pad={attrs['auto_pad']!r} unsupported "
+                "(explicit pads only)")
+
+    def op_ConvTranspose(self, node, attrs, ins):
+        self._check_auto_pad(node, attrs)
+        kernel = attrs["kernel_shape"]
+        ndim = len(kernel)
+        pads = attrs.get("pads", [0] * 2 * ndim)
+        if pads[:ndim] != pads[ndim:]:
+            raise MXNetError(
+                "ONNX import: asymmetric ConvTranspose pads unsupported")
+        if attrs.get("output_shape"):
+            raise MXNetError(
+                "ONNX import: ConvTranspose output_shape unsupported")
+        w = self.const_shape(node["input"][1])
+        group = int(attrs.get("group", 1))
+        out = self.sym().Deconvolution(
+            *ins, kernel=tuple(kernel),
+            stride=tuple(attrs.get("strides", [1] * ndim)),
+            dilate=tuple(attrs.get("dilations", [1] * ndim)),
+            pad=tuple(pads[:ndim]),
+            adj=tuple(attrs.get("output_padding", [0] * ndim)),
+            num_group=group, num_filter=int(w[1]) * group,
+            no_bias=len(ins) == 2, name=self._name(node))
+        self.set_out(node, [out])
 
     def op_BatchNormalization(self, node, attrs, ins):
         for aux in node["input"][3:5]:

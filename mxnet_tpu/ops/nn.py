@@ -133,11 +133,39 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     pad = _pair(pad, n)
     adj = _pair(adj, n) if adj else (0,) * n
     spatial = "DHW"[-n:]
+    lhs, rhs, downcast = _safe_acc(data, weight)
+    # transposed conv = dilated conv with the SPATIALLY FLIPPED kernel
+    # (conv_general_dilated correlates; the gradient-of-conv semantics
+    # need the flip) ...
     if _channels_last(layout):
+        sp_axes = tuple(range(1, 1 + n))  # weight (I, *k, O)
         specs = ("N" + spatial + "C", "I" + spatial + "O", "N" + spatial + "C")
     else:
+        sp_axes = tuple(range(2, 2 + n))  # weight (I, O/g, *k)
         specs = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, specs)
+    rhs = jnp.flip(rhs, sp_axes)
+    if num_group > 1:
+        # ... and grouped weights regroup to what feature_group_count
+        # expects: rhs (I/g, O_total, *k) where O-blocks line up with the
+        # input-channel blocks.  (C_in, C_out/g, *k) ->
+        # (g, C_in/g, C_out/g, *k) -> (C_in/g, g, C_out/g, *k) ->
+        # (C_in/g, C_out, *k)
+        if _channels_last(layout):
+            # (C_in, *k, C_out/g): move I to front grouping similarly
+            cin = rhs.shape[0]
+            rhs = rhs.reshape((num_group, cin // num_group)
+                              + rhs.shape[1:])
+            rhs = jnp.moveaxis(rhs, 0, -2)  # (C_in/g, *k, g, C_out/g)
+            rhs = rhs.reshape(rhs.shape[:-2]
+                              + (num_group * rhs.shape[-1],))
+        else:
+            cin = rhs.shape[0]
+            rhs = rhs.reshape((num_group, cin // num_group)
+                              + rhs.shape[1:])
+            rhs = jnp.swapaxes(rhs, 0, 1)  # (C_in/g, g, C_out/g, *k)
+            rhs = rhs.reshape((cin // num_group,
+                               num_group * rhs.shape[2]) + rhs.shape[3:])
+    dn = jax.lax.conv_dimension_numbers(data.shape, rhs.shape, specs)
     # lhs_dilation implements the fractional stride; padding chosen so that
     # out = (in-1)*s - 2p + dilate*(k-1) + 1 + adj  (MXNet's formula)
     pads = []
@@ -146,7 +174,6 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
         lo = k - 1 - pad[i]
         hi = k - 1 - pad[i] + adj[i]
         pads.append((lo, hi))
-    lhs, rhs, downcast = _safe_acc(data, weight)
     out = jax.lax.conv_general_dilated(
         lhs, rhs,
         window_strides=(1,) * n,

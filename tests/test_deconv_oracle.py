@@ -1,0 +1,41 @@
+"""Deconvolution vs the torch oracle (reference:
+src/operator/nn/deconvolution-inl.h — transposed conv = gradient of conv).
+
+The r5 ONNX review exposed that the dilated-conv formulation was missing
+the spatial kernel FLIP (plain deconv was numerically wrong, not just
+grouped deconv broken) — loss-decrease tests can't catch kernel
+orientation, so this pins every config against torch.conv_transpose2d."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mxnet_tpu import nd  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "cin,cout_per_g,groups,kernel,stride,pad,adj,dilate",
+    [
+        (4, 3, 1, (3, 3), (2, 2), (1, 1), (1, 1), (1, 1)),
+        (4, 2, 2, (3, 3), (2, 2), (1, 1), (1, 1), (1, 1)),
+        (6, 2, 3, (2, 2), (1, 1), (0, 0), (0, 0), (1, 1)),
+        (4, 3, 1, (2, 3), (1, 1), (0, 0), (0, 0), (2, 2)),  # asymmetric k
+        (4, 3, 1, (3, 3), (3, 3), (2, 2), (2, 2), (1, 1)),
+    ])
+def test_deconvolution_matches_torch(cin, cout_per_g, groups, kernel,
+                                     stride, pad, adj, dilate):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, cin, 5, 5).astype(np.float32)
+    w = rng.randn(cin, cout_per_g, *kernel).astype(np.float32)
+    b = rng.randn(cout_per_g * groups).astype(np.float32)
+
+    y_ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b),
+        stride=stride, padding=pad, output_padding=adj,
+        dilation=dilate, groups=groups).numpy()
+    y = nd.Deconvolution(
+        nd.array(x), nd.array(w), nd.array(b), kernel=kernel,
+        stride=stride, pad=pad, adj=adj, dilate=dilate,
+        num_filter=cout_per_g * groups, num_group=groups,
+        no_bias=False).asnumpy()
+    np.testing.assert_allclose(y_ref, y, atol=5e-5, rtol=1e-4)

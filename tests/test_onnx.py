@@ -185,11 +185,22 @@ def test_fix_gamma_exported_as_ones():
 
 def test_unsupported_op_raises_with_name():
     x = sym.Variable("x")
-    s = sym.Deconvolution(x, kernel=(2, 2), num_filter=2, name="dc")
-    with pytest.raises(MXNetError, match="Deconvolution"):
-        onnx_mxnet.export_model(s, _fill_params(s, {"x": (1, 3, 4, 4)}),
-                                [(1, 3, 4, 4)], np.float32,
+    s = sym.Correlation(x, x, name="corr")
+    with pytest.raises(MXNetError, match="Correlation"):
+        onnx_mxnet.export_model(s, _fill_params(s, {"x": (1, 2, 6, 6)}),
+                                [(1, 2, 6, 6)], np.float32,
                                 os.path.join(tempfile.mkdtemp(), "m.onnx"))
+
+
+def test_deconvolution_roundtrip():
+    data = sym.Variable("data")
+    dc = sym.Deconvolution(data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           adj=(1, 1), num_filter=4, no_bias=False,
+                           name="dc")
+    s = sym.Activation(dc, act_type="relu", name="r")
+    feeds = {"data": np.random.RandomState(9).rand(2, 3, 5, 5)
+             .astype("float32")}
+    _roundtrip(s, _fill_params(s, {"data": (2, 3, 5, 5)}), feeds)
 
 
 def test_get_model_metadata():
@@ -336,6 +347,28 @@ def test_seq2seq_transformer_roundtrip():
     ex.arg_dict["tgt"][:] = nd.array(tgt, dtype="int32")
     y2 = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(y_ref, y2, atol=1e-5, rtol=1e-4)
+
+
+def test_bert_import_to_gluon():
+    """ONNX BERT -> SymbolBlock via import_to_gluon: parameter binding by
+    initializer name at model scale, int32 token inputs."""
+    import mxnet_tpu as mx2
+    from mxnet_tpu.models import bert_small
+
+    net = bert_small(num_layers=1)
+    net.initialize(mx2.init.Normal(0.02))
+    tok = np.random.RandomState(3).randint(0, 512, (2, 8)).astype("int32")
+    y_ref = net(nd.array(tok, dtype="int32")).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        net.export(os.path.join(d, "b"))
+        path = onnx_mxnet.export_model(
+            os.path.join(d, "b-symbol.json"),
+            os.path.join(d, "b-0000.params"),
+            [(2, 8)], np.int32, os.path.join(d, "b.onnx"))
+        g = onnx_mxnet.import_to_gluon(path)
+    y2 = g(nd.array(tok, dtype="int32"))
+    y2 = (y2[0] if isinstance(y2, (list, tuple)) else y2).asnumpy()
+    np.testing.assert_allclose(y_ref, y2, atol=2e-5, rtol=1e-4)
 
 
 @pytest.mark.slow
