@@ -130,7 +130,8 @@ def _deconv(ctx, node, ins, outs, attrs):
         raise MXNetError("ONNX export: Deconvolution target_shape "
                          "unsupported (use adj/output_padding)")
     kw = _conv_common("Deconvolution", attrs)
-    adj = [int(a) for a in attrs.get("adj", ())]
+    ndim = len(kw["kernel_shape"])
+    adj = _pair(attrs, "adj", ndim, 0)  # scalar adj broadcasts like the op
     if any(adj):
         kw["output_padding"] = adj
     ctx.add_node("ConvTranspose", ins, outs, name=node.name, **kw)

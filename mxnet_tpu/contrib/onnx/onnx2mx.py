@@ -100,8 +100,8 @@ class _Importer:
     @staticmethod
     def _check_auto_pad(node, attrs):
         # SAME_UPPER/SAME_LOWER carry no pads attr; importing them as
-        # pad=0 would be silently wrong
-        if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
+        # pad=0 would be silently wrong.  VALID *is* pads=0 — allowed.
+        if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", "", "VALID"):
             raise MXNetError(
                 f"ONNX import: {node['op_type']} "
                 f"auto_pad={attrs['auto_pad']!r} unsupported "

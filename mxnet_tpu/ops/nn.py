@@ -128,6 +128,12 @@ def deconvolution(data, weight, *bias, kernel=(), stride=(), dilate=(), pad=(),
     """Transposed convolution (reference src/operator/nn/deconvolution-inl.h).
     Weight layout (C_in, C_out/group, *kernel) as in MXNet."""
     n = _conv_dims(kernel)
+    if target_shape:
+        # MXNet derives pad from target_shape; silently ignoring it would
+        # return a differently-padded tensor
+        raise NotImplementedError(
+            "Deconvolution target_shape is not supported; give pad/adj "
+            "explicitly (out = (in-1)*s - 2p + d*(k-1) + 1 + adj)")
     stride = _pair(stride or 1, n)
     dilate = _pair(dilate or 1, n)
     pad = _pair(pad, n)

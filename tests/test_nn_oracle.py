@@ -1,0 +1,150 @@
+"""Core NN ops vs the torch oracle (CPU build baked into the image).
+
+The r5 Deconvolution finding (missing kernel flip — numerically wrong
+for years of rounds, invisible to loss-decrease tests AND to the
+cpu-vs-tpu consistency suite, which compares the same formula against
+itself) motivates pinning every convention-sensitive op to an external
+implementation: conv (grouping/dilation/stride/padding conventions),
+pooling (ceil_mode, count_include_pad), the norm family, and the exact
+activation formulas."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+from mxnet_tpu import nd  # noqa: E402
+
+RS = np.random.RandomState
+
+
+@pytest.mark.parametrize(
+    "cin,cout,groups,kernel,stride,pad,dilate",
+    [
+        (3, 8, 1, (3, 3), (1, 1), (1, 1), (1, 1)),
+        (4, 8, 2, (3, 3), (2, 2), (1, 1), (1, 1)),
+        (4, 4, 4, (3, 3), (1, 1), (1, 1), (1, 1)),   # depthwise
+        (3, 6, 1, (2, 3), (2, 1), (0, 2), (1, 1)),   # asym everything
+        (3, 6, 1, (3, 3), (1, 1), (2, 2), (2, 2)),   # dilated
+    ])
+def test_convolution_matches_torch(cin, cout, groups, kernel, stride, pad,
+                                   dilate):
+    rng = RS(0)
+    x = rng.randn(2, cin, 9, 9).astype(np.float32)
+    w = rng.randn(cout, cin // groups, *kernel).astype(np.float32)
+    b = rng.randn(cout).astype(np.float32)
+    ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=stride, padding=pad, dilation=dilate,
+                    groups=groups).numpy()
+    got = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=kernel, stride=stride, pad=pad,
+                         dilate=dilate, num_filter=cout,
+                         num_group=groups, no_bias=False).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=5e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("convention", ["valid", "full"])
+@pytest.mark.parametrize("pool", ["max", "avg"])
+def test_pooling_matches_torch(pool, convention):
+    rng = RS(1)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    kw = dict(kernel_size=3, stride=2, padding=1,
+              ceil_mode=convention == "full")
+    if pool == "max":
+        ref = TF.max_pool2d(torch.tensor(x), **kw).numpy()
+    else:
+        ref = TF.avg_pool2d(torch.tensor(x), count_include_pad=True,
+                            **kw).numpy()
+    got = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1), pool_type=pool,
+                     pooling_convention=convention).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=1e-6, rtol=1e-6)
+
+
+def test_avg_pool_exclude_pad_matches_torch():
+    rng = RS(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    ref = TF.avg_pool2d(torch.tensor(x), kernel_size=3, stride=2,
+                        padding=1, count_include_pad=False).numpy()
+    got = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1), pool_type="avg",
+                     count_include_pad=False).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=1e-6, rtol=1e-6)
+
+
+def test_batchnorm_inference_matches_torch():
+    rng = RS(3)
+    x = rng.randn(2, 5, 4, 4).astype(np.float32)
+    gamma = rng.rand(5).astype(np.float32) + 0.5
+    beta = rng.randn(5).astype(np.float32)
+    mean = rng.randn(5).astype(np.float32)
+    var = rng.rand(5).astype(np.float32) + 0.5
+    ref = TF.batch_norm(torch.tensor(x), torch.tensor(mean),
+                        torch.tensor(var), torch.tensor(gamma),
+                        torch.tensor(beta), training=False,
+                        eps=1e-3).numpy()
+    got = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), eps=1e-3,
+                       fix_gamma=False, use_global_stats=True).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=1e-5, rtol=1e-5)
+
+
+def test_layernorm_matches_torch():
+    rng = RS(4)
+    x = rng.randn(3, 7, 16).astype(np.float32)
+    gamma = rng.rand(16).astype(np.float32) + 0.5
+    beta = rng.randn(16).astype(np.float32)
+    ref = TF.layer_norm(torch.tensor(x), (16,), torch.tensor(gamma),
+                        torch.tensor(beta), eps=1e-5).numpy()
+    got = nd.LayerNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       axis=-1, eps=1e-5).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=2e-5, rtol=1e-5)
+
+
+def test_instance_group_norm_match_torch():
+    rng = RS(5)
+    x = rng.randn(2, 6, 5, 5).astype(np.float32)
+    gamma = rng.rand(6).astype(np.float32) + 0.5
+    beta = rng.randn(6).astype(np.float32)
+    ref = TF.instance_norm(torch.tensor(x), weight=torch.tensor(gamma),
+                           bias=torch.tensor(beta), eps=1e-3).numpy()
+    got = nd.InstanceNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          eps=1e-3).asnumpy()
+    np.testing.assert_allclose(ref, got, atol=2e-5, rtol=1e-5)
+
+    ref_g = TF.group_norm(torch.tensor(x), 3, torch.tensor(gamma),
+                          torch.tensor(beta), eps=1e-3).numpy()
+    got_g = nd.GroupNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                         num_groups=3, eps=1e-3).asnumpy()
+    np.testing.assert_allclose(ref_g, got_g, atol=2e-5, rtol=1e-5)
+
+
+def test_activation_formulas_match_torch():
+    rng = RS(6)
+    x = rng.randn(4, 33).astype(np.float32) * 3
+    tx = torch.tensor(x)
+    cases = [
+        (nd.LeakyReLU(nd.array(x), act_type="gelu"),
+         TF.gelu(tx)),                                   # exact erf form
+        (nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0),
+         TF.elu(tx, alpha=1.0)),
+        (nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1),
+         TF.leaky_relu(tx, 0.1)),
+        (nd.Activation(nd.array(x), act_type="softrelu"),
+         TF.softplus(tx)),
+        (nd.Activation(nd.array(x), act_type="softsign"),
+         TF.softsign(tx)),
+        (nd.log_softmax(nd.array(x), axis=-1),
+         TF.log_softmax(tx, dim=-1)),
+    ]
+    for got, ref in cases:
+        np.testing.assert_allclose(ref.numpy(), got.asnumpy(),
+                                   atol=2e-5, rtol=1e-5)
+
+
+def test_selu_matches_torch():
+    rng = RS(7)
+    x = rng.randn(3, 9).astype(np.float32)
+    ref = TF.selu(torch.tensor(x)).numpy()
+    got = nd.LeakyReLU(nd.array(x), act_type="selu").asnumpy()
+    np.testing.assert_allclose(ref, got, atol=2e-5, rtol=1e-5)
