@@ -142,6 +142,69 @@ def test_activation_formulas_match_torch():
                                    atol=2e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_lstm_matches_torch(bidirectional):
+    """Fused RNN op (mode='lstm') vs torch.nn.LSTM — both use the
+    (i, f, g, o) cuDNN gate order, so torch weights pack directly into
+    the MXNet flat vector (i2h w, h2h w per layer/dir, then biases)."""
+    rng = RS(8)
+    T, B, I, H = 5, 3, 4, 6
+    dirs = 2 if bidirectional else 1
+    x = rng.randn(T, B, I).astype(np.float32)
+    ref_rnn = torch.nn.LSTM(I, H, num_layers=1,
+                            bidirectional=bidirectional)
+    with torch.no_grad():
+        ref_out, (ref_h, ref_c) = ref_rnn(torch.tensor(x))
+    sd = ref_rnn.state_dict()
+    weights, biases = [], []
+    for d in range(dirs):
+        sfx = "_reverse" if d else ""
+        weights += [sd[f"weight_ih_l0{sfx}"].numpy().ravel(),
+                    sd[f"weight_hh_l0{sfx}"].numpy().ravel()]
+        biases += [sd[f"bias_ih_l0{sfx}"].numpy().ravel(),
+                   sd[f"bias_hh_l0{sfx}"].numpy().ravel()]
+    flat = np.concatenate(weights + biases).astype(np.float32)
+    h0 = np.zeros((dirs, B, H), np.float32)
+    c0 = np.zeros((dirs, B, H), np.float32)
+    out, hN, cN = nd.RNN(nd.array(x), nd.array(flat), nd.array(h0),
+                         nd.array(c0), state_size=H, num_layers=1,
+                         mode="lstm", bidirectional=bidirectional,
+                         state_outputs=True)
+    np.testing.assert_allclose(ref_out.numpy(), out.asnumpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(ref_h.numpy(), hN.asnumpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(ref_c.numpy(), cN.asnumpy(),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_gru_matches_torch():
+    """mode='gru' vs torch.nn.GRU: both (r, z, n) gate order.  NOTE the
+    n-gate bias convention matters: cuDNN/MXNet apply r AFTER adding the
+    h2h bias (n = tanh(i_n + b_in + r*(h W_hn^T + b_hn))), and torch.GRU
+    matches that cuDNN form on CPU too."""
+    rng = RS(9)
+    T, B, I, H = 5, 3, 4, 6
+    x = rng.randn(T, B, I).astype(np.float32)
+    ref_rnn = torch.nn.GRU(I, H, num_layers=1)
+    with torch.no_grad():
+        ref_out, ref_h = ref_rnn(torch.tensor(x))
+    sd = ref_rnn.state_dict()
+    flat = np.concatenate([
+        sd["weight_ih_l0"].numpy().ravel(),
+        sd["weight_hh_l0"].numpy().ravel(),
+        sd["bias_ih_l0"].numpy().ravel(),
+        sd["bias_hh_l0"].numpy().ravel()]).astype(np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    out, hN = nd.RNN(nd.array(x), nd.array(flat), nd.array(h0),
+                     state_size=H, num_layers=1, mode="gru",
+                     state_outputs=True)
+    np.testing.assert_allclose(ref_out.numpy(), out.asnumpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(ref_h.numpy(), hN.asnumpy(),
+                               atol=2e-5, rtol=1e-4)
+
+
 def test_selu_matches_torch():
     rng = RS(7)
     x = rng.randn(3, 9).astype(np.float32)
