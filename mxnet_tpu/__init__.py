@@ -59,6 +59,7 @@ from . import subgraph
 from . import visualization
 from . import visualization as viz
 from . import checkpoint
+from . import fault
 from . import rtc
 from . import test_utils
 from . import contrib

@@ -1,4 +1,4 @@
-"""Step-granular asynchronous checkpointing.
+"""Step-granular asynchronous checkpointing with verified restore.
 
 Reference posture (SURVEY §5.3): the reference's only recovery story is
 epoch-granularity save_checkpoint callbacks; a dead worker stalls
@@ -7,23 +7,38 @@ written by a background thread (the training loop never blocks on disk),
 atomic rename-into-place, rotation, and a manifest for resume — the
 checkpoint/restart pattern pods use for preemption recovery.
 
+Integrity (docs/FAULT_TOLERANCE.md): every payload file's SHA-256 digest
+is recorded in ``meta.json``; loads verify digests and fall back to the
+next-newest *valid* ``step-*`` directory when the newest one is torn,
+truncated, or missing — a preempted pod must never be unrecoverable
+because it died mid-write.  ``mxnet_tpu.fault`` hooks are threaded through
+the writer so every one of those failure shapes is reproducible on demand
+(``MX_FAULT_SPEC``).
+
 Includes the RNG key (the reference's noted gap: "RNG state NOT
 checkpointed") so a restored run continues the exact sample sequence.
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import queue
 import shutil
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import fault
 from .base import MXNetError
 
-__all__ = ["AsyncCheckpointer", "load_checkpoint_state", "restore"]
+__all__ = ["AsyncCheckpointer", "load_checkpoint_state", "restore",
+           "latest_valid_step", "agree_resume_step"]
+
+_LOG = logging.getLogger("mxnet_tpu.checkpoint")
 
 
 def _snapshot_params(net_or_params) -> Dict[str, np.ndarray]:
@@ -69,19 +84,43 @@ class AsyncCheckpointer:
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
         if initial_step is None:
+            # continue numbering from the newest step on disk; a torn
+            # `latest` file must not reset numbering to 0 (collision +
+            # rotation against the pre-crash dirs), so fall back to the
+            # step-* dir names when it is unreadable
+            candidates = _candidate_steps(directory)
+            initial_step = candidates[0] if candidates else 0
+        else:
+            # explicit resume step (gang-agreed): step dirs ABOVE it are
+            # an abandoned timeline — e.g. the previous incarnation's
+            # preemption checkpoint the gang agreed NOT to resume from.
+            # Left in place they would poison rotation ("newest" by
+            # number) and latest_valid_step would resurrect them after
+            # the next crash, restoring state this run never reached.
+            for s in _candidate_steps(directory):
+                if s > initial_step:
+                    shutil.rmtree(os.path.join(directory, f"step-{s}"),
+                                  ignore_errors=True)
             latest = os.path.join(directory, "latest")
-            if os.path.exists(latest):
+            try:
                 with open(latest) as f:
-                    initial_step = int(f.read().strip())
-            else:
-                initial_step = 0
+                    if int(f.read().strip()) > initial_step:
+                        os.remove(latest)
+            except (OSError, ValueError):
+                pass
         self._step = int(initial_step)
-        # garbage-collect tmp dirs a crashed writer left behind
+        # garbage-collect staging leftovers a crashed writer left behind
         for d in os.listdir(directory):
             if d.startswith(".tmp-"):
                 shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
+            elif d.startswith(".latest.tmp"):
+                try:
+                    os.remove(os.path.join(directory, d))
+                except OSError:
+                    pass
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: Optional[BaseException] = None
+        self._closed = False
         self._writer = threading.Thread(target=self._writer_loop, daemon=True)
         self._writer.start()
 
@@ -92,6 +131,9 @@ class AsyncCheckpointer:
         if self._error is not None:
             raise MXNetError(f"checkpoint writer failed: {self._error}")
         self._step += 1
+        # chaos harness: `crash:step=N` dies HERE, before step N's
+        # checkpoint can be enqueued — deterministic for tests
+        fault.on_train_step(self._step)
         if self._step % self.save_every != 0:
             return False
         snap = {
@@ -115,9 +157,56 @@ class AsyncCheckpointer:
             raise MXNetError(f"checkpoint writer failed: {self._error}")
 
     def close(self) -> None:
-        self.wait()
-        self._queue.put(None)
-        self._writer.join()
+        """Flush pending writes and stop the writer thread.
+
+        The thread is ALWAYS sent its sentinel and joined, even when a
+        pending write failed — only then is the writer error re-raised
+        (previously an error in wait() leaked the thread forever)."""
+        if self._closed:
+            if self._error is not None:
+                raise MXNetError(f"checkpoint writer failed: {self._error}")
+            return
+        self._closed = True
+        try:
+            self.wait()
+        finally:
+            self._queue.put(None)
+            self._writer.join()
+
+    def save_now(self, params, trainer=None, extra: Optional[dict] = None,
+                 drain_timeout: float = 5.0) -> int:
+        """Synchronously checkpoint the CURRENT step on the calling thread
+        (the preemption path: fault.install_preemption_handler calls this
+        from the SIGTERM handler, then exits).  Returns the step written,
+        0 when no step has been taken yet.
+
+        Runs inside a signal handler, so it must not touch the queue's
+        (non-reentrant) lock — SIGTERM can land while the main thread is
+        inside put()/join() holding it.  In-flight async writes are
+        drained by a bounded lock-free poll of unfinished_tasks instead;
+        on timeout we write anyway: staging dirs are thread-unique, a
+        same-step double publish is two snapshots of identical logical
+        state, and validation tolerates a racy `latest`."""
+        if self._step == 0:
+            return 0
+        snap = {
+            "step": self._step,
+            "params": _snapshot_params(params),
+            "trainer": (self._trainer_states(trainer)
+                        if trainer is not None else None),
+            "rng": self._rng_state(),
+            "extra": extra or {},
+        }
+        deadline = time.monotonic() + drain_timeout
+        while self._queue.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if self._queue.unfinished_tasks and self._step % self.save_every == 0:
+            # the writer thread is still persisting THIS very step; racing
+            # it on the same final dir publishes nothing new (identical
+            # logical state) and could only corrupt — let it finish
+            return 0
+        self._write(snap)
+        return self._step
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -155,74 +244,223 @@ class AsyncCheckpointer:
         from . import ndarray as nd
 
         step = snap["step"]
-        tmp = os.path.join(self.dir, f".tmp-{step}")
+        fault.on_write_begin(step)
+        # thread-unique staging dir: save_now (signal handler, main
+        # thread) may race the writer thread on the SAME step when the
+        # drain timed out — two writers must never share a tmp dir
+        tmp = os.path.join(self.dir,
+                           f".tmp-{step}-{threading.get_ident()}")
         final = os.path.join(self.dir, f"step-{step}")
         if os.path.exists(tmp):
             # leftover from a crashed writer: its stale contents must not
             # be published into this checkpoint
             shutil.rmtree(tmp)
         os.makedirs(tmp)
+        digests = {}
         nd_utils.save(os.path.join(tmp, "params.nd"),
                       {k: nd.array(v, dtype=v.dtype)
                        for k, v in snap["params"].items()})
+        digests["params.nd"] = _sha256_file(os.path.join(tmp, "params.nd"))
         if snap["trainer"] is not None:
             with open(os.path.join(tmp, "trainer.states"), "wb") as f:
                 f.write(snap["trainer"])
+            digests["trainer.states"] = _sha256_file(
+                os.path.join(tmp, "trainer.states"))
+        fault.on_write_mid(step)
+        # meta.json is written LAST and carries the payload digests: a
+        # parseable meta whose digests verify is the definition of a
+        # valid checkpoint (load_checkpoint_state)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "rng": snap["rng"],
-                       "extra": snap["extra"]}, f)
+                       "extra": snap["extra"], "digests": digests}, f)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
-        with open(os.path.join(self.dir, ".latest.tmp"), "w") as f:
+        # thread-unique staging for `latest` too: save_now (main thread)
+        # and the writer thread may publish different steps concurrently
+        latest_tmp = os.path.join(
+            self.dir, f".latest.tmp-{threading.get_ident()}")
+        with open(latest_tmp, "w") as f:
             f.write(str(step))
-        os.replace(os.path.join(self.dir, ".latest.tmp"),
-                   os.path.join(self.dir, "latest"))
-        # rotate
+        os.replace(latest_tmp, os.path.join(self.dir, "latest"))
+        # rotate.  Off-cycle steps (save_now preemption checkpoints) must
+        # never evict a scheduled save_every multiple: the gang's agreed
+        # resume step is always a scheduled one, and deleting it on one
+        # rank would make restore(step=agreed) raise on the next restart —
+        # an unrecoverable job.  An off-cycle step is itself retained only
+        # until the next scheduled checkpoint supersedes it.
         steps = sorted(
             int(d.split("-")[1]) for d in os.listdir(self.dir)
             if d.startswith("step-"))
-        for old in steps[: -self.keep]:
+        scheduled = [s for s in steps if s % self.save_every == 0]
+        extra = [s for s in steps if s % self.save_every != 0]
+        drop = scheduled[: -self.keep]
+        drop += extra[:-1]
+        if extra and scheduled and extra[-1] < scheduled[-1]:
+            drop.append(extra[-1])  # superseded by a newer scheduled step
+        for old in drop:
             shutil.rmtree(os.path.join(self.dir, f"step-{old}"),
                           ignore_errors=True)
+        fault.on_write_published(step, final)
 
 
-def load_checkpoint_state(directory: str):
-    """Load the newest checkpoint: dict(step, params (name->NDArray),
-    trainer (bytes or None), extra) — or None when none exists.  Restores
-    the RNG key as a side effect (reference gap closed)."""
-    latest = os.path.join(directory, "latest")
-    if not os.path.exists(latest):
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _candidate_steps(directory: str) -> List[int]:
+    """Step numbers worth trying, newest first: the `latest` pointer (when
+    readable) plus every step-* dir — so a torn/missing `latest` never
+    hides an intact checkpoint."""
+    steps = set()
+    try:
+        with open(os.path.join(directory, "latest")) as f:
+            steps.add(int(f.read().strip()))
+    except (OSError, ValueError):
+        pass
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for dname in names:
+        if dname.startswith("step-"):
+            try:
+                steps.add(int(dname.split("-", 1)[1]))
+            except ValueError:
+                pass
+    return sorted(steps, reverse=True)
+
+
+def _read_meta_if_valid(d: str):
+    """Parsed meta.json iff the checkpoint dir is complete and every
+    recorded digest verifies; None for any torn/corrupt/missing shape."""
+    try:
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
         return None
-    with open(latest) as f:
-        step = int(f.read().strip())
-    d = os.path.join(directory, f"step-{step}")
+    if not isinstance(meta, dict) or "step" not in meta:
+        return None
+    digests = meta.get("digests")
+    if digests is None:
+        # pre-digest checkpoint (older layout): existence check only
+        return meta if os.path.exists(os.path.join(d, "params.nd")) else None
+    for fname, want in digests.items():
+        try:
+            if _sha256_file(os.path.join(d, fname)) != want:
+                return None
+        except OSError:
+            return None
+    return meta
+
+
+def latest_valid_step(directory: str,
+                      multiple_of: Optional[int] = None) -> int:
+    """Newest step whose checkpoint verifies (digests + parseable meta);
+    0 when the directory holds no valid checkpoint.
+
+    With ``multiple_of=save_every`` only SCHEDULED steps are considered —
+    the inventory every rank of a gang is guaranteed to share.  Gang
+    resume (agree_resume_step) must run on this: off-cycle preemption
+    checkpoints land at rank-specific steps (wherever SIGTERM caught each
+    rank), so an off-cycle step can never be a common resume point."""
+    for s in _candidate_steps(directory):
+        if multiple_of and s % multiple_of != 0:
+            continue
+        if _read_meta_if_valid(os.path.join(directory, f"step-{s}")) is not None:
+            return s
+    return 0
+
+
+def agree_resume_step(local_step: int, kv=None) -> int:
+    """Gang-consistent resume step: the MINIMUM over all ranks' local
+    steps.  After a supervised restart (tools/launch.py --max-restarts)
+    ranks hold checkpoints at different steps — a preemption-handler
+    checkpoint lands wherever SIGTERM caught that rank — but sync-SGD
+    requires every rank to resume from the SAME step.
+
+    Callers MUST pass ``latest_valid_step(dir, multiple_of=save_every)``
+    (scheduled steps only): under whole-gang preemption EVERY rank writes
+    an off-cycle final checkpoint at a slightly different step, and the
+    minimum of those exists on one rank only — restore(step=min) would
+    raise everywhere else.  Every rank holds the scheduled minimum with
+    keep >= 2: lock-step training bounds the cross-rank skew to one save
+    interval, and rotation never lets an off-cycle preemption checkpoint
+    evict a scheduled one."""
+    if kv is None or getattr(kv, "num_workers", 1) <= 1:
+        return int(local_step)
+    from . import ndarray as nd
+
+    vec = np.zeros(kv.num_workers, np.float32)
+    vec[kv.rank] = float(local_step)
+    summed = kv._global_sum(nd.array(vec)).asnumpy()
+    return int(round(summed.min()))
+
+
+def load_checkpoint_state(directory: str, step: Optional[int] = None):
+    """Load the newest VALID checkpoint: dict(step, params (name->NDArray),
+    trainer (bytes or None), extra) — or None when no valid one exists.
+    Restores the RNG key as a side effect (reference gap closed).
+
+    Integrity: a candidate whose meta.json is torn, whose digests
+    mismatch, or whose payload fails to decode is skipped (with a warning)
+    in favor of the next-newest step — a crash mid-write must never make
+    the job unrecoverable.  With ``step=N`` the exact step is demanded and
+    an invalid/missing step-N raises (gang-consistent resume must not
+    silently diverge)."""
     from .ndarray import utils as nd_utils
 
-    params = nd_utils.load(os.path.join(d, "params.nd"))
-    with open(os.path.join(d, "meta.json")) as f:
-        meta = json.load(f)
-    trainer_states = None
-    tpath = os.path.join(d, "trainer.states")
-    if os.path.exists(tpath):
-        with open(tpath, "rb") as f:
-            trainer_states = f.read()
-    if meta.get("rng") is not None:
-        import jax.numpy as jnp
+    explicit = step is not None
+    candidates = [int(step)] if explicit else _candidate_steps(directory)
+    for s in candidates:
+        d = os.path.join(directory, f"step-{s}")
+        meta = _read_meta_if_valid(d)
+        if meta is None:
+            if explicit:
+                raise MXNetError(
+                    f"checkpoint step {s} in {directory} is missing or "
+                    "corrupt (demanded via step=)")
+            _LOG.warning("checkpoint %s is torn/corrupt; falling back to "
+                         "the next-newest step", d)
+            continue
+        try:
+            params = nd_utils.load(os.path.join(d, "params.nd"))
+        except Exception as e:  # undecodable payload (pre-digest torn file)
+            if explicit:
+                raise MXNetError(
+                    f"checkpoint step {s} in {directory} failed to load: "
+                    f"{e}") from e
+            _LOG.warning("checkpoint %s failed to load (%s); falling back",
+                         d, e)
+            continue
+        trainer_states = None
+        tpath = os.path.join(d, "trainer.states")
+        if os.path.exists(tpath):
+            with open(tpath, "rb") as f:
+                trainer_states = f.read()
+        if meta.get("rng") is not None:
+            import jax.numpy as jnp
 
-        from . import random as mx_random
+            from . import random as mx_random
 
-        mx_random._state.key = jnp.asarray(
-            np.asarray(meta["rng"], np.uint32))
-    return {"step": step, "params": params, "trainer": trainer_states,
-            "extra": meta.get("extra", {})}
+            mx_random._state.key = jnp.asarray(
+                np.asarray(meta["rng"], np.uint32))
+        return {"step": s, "params": params, "trainer": trainer_states,
+                "extra": meta.get("extra", {})}
+    return None
 
 
-def restore(directory: str, net, trainer=None) -> int:
-    """Apply the newest checkpoint to `net` (structural names) and
-    `trainer`; restores the RNG key.  Returns the restored step (0 when
-    no checkpoint exists) — the working end of the resume recipe."""
-    state = load_checkpoint_state(directory)
+def restore(directory: str, net, trainer=None,
+            step: Optional[int] = None) -> int:
+    """Apply the newest valid checkpoint (or exactly ``step=N``) to `net`
+    (structural names) and `trainer`; restores the RNG key.  Returns the
+    restored step (0 when no valid checkpoint exists) — the working end of
+    the resume recipe."""
+    state = load_checkpoint_state(directory, step=step)
     if state is None:
         return 0
     params = net._collect_params_with_prefix() if hasattr(

@@ -82,6 +82,18 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "DMLC_NUM_SERVER": (
         "absorbed", "no parameter-server role in the SPMD design"),
     "PS_VERBOSE": ("absorbed", "see DMLC_NUM_SERVER"),
+    # fault-tolerance layer (docs/FAULT_TOLERANCE.md) — TPU-native vars
+    # with no reference counterpart
+    "MX_FAULT_SPEC": (
+        "honored", "fault-injection harness: crash / crash-write / "
+        "torn-write / slow-write specs with rank=/if-restart= qualifiers "
+        "(fault.py, hooks in checkpoint.py)"),
+    "MX_RENDEZVOUS_TIMEOUT": (
+        "honored", "seconds a (re)started rank retries "
+        "jax.distributed.initialize with backoff (parallel/dist.py)"),
+    "MX_RESTART_COUNT": (
+        "honored", "gang incarnation index exported by tools/launch.py "
+        "--max-restarts; read by fault.py if-restart= and resume logic"),
 }
 
 _warned = False

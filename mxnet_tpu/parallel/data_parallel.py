@@ -390,7 +390,13 @@ class DataParallelStep:
             return new_params, new_state, loss
 
         repl = replicated(self.mesh)
-        donate = (0, 1) if self._donate else ()
+        # XLA:CPU's runtime aliasing check rejects a donated param whose
+        # incoming layout/sharding differs from its out_sharding
+        # ("INTERNAL: Expected aliased input ... to have the same size",
+        # seen on dp×tp CPU meshes).  Donation only saves device memory,
+        # so keep it for accelerators and skip it on CPU hosts.
+        mesh_platform = next(iter(self.mesh.devices.flat)).platform
+        donate = (0, 1) if (self._donate and mesh_platform != "cpu") else ()
         self._jitted = jax.jit(
             step,
             out_shardings=(self._shardings, None, repl),

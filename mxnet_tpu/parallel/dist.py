@@ -19,6 +19,7 @@ spellings are honored so reference launch scripts work unchanged):
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -89,10 +90,66 @@ def init_from_env(force_cpu: Optional[bool] = None) -> bool:
             "jax.distributed.initialize() yourself at program start.")
     if force_cpu or (force_cpu is None and _env("MX_FORCE_CPU") == "1"):
         jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=int(n), process_id=int(rank))
+    # CPU hosts need an explicit cross-process collectives implementation:
+    # the default ("none") makes every multiprocess computation fail with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Harmless on TPU (the flag only affects CPU client creation).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # jax versions without the flag pick gloo themselves
+        pass
+    _initialize_with_retry(coord, int(n), int(rank))
     _initialized = True
     return jax.process_count() > 1
+
+
+def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
+    """jax.distributed.initialize with exponential-backoff retries up to
+    MX_RENDEZVOUS_TIMEOUT seconds (default 300).
+
+    After a supervised gang restart (tools/launch.py --max-restarts) the
+    re-spawned ranks race the new coordinator: a non-zero rank can dial
+    before rank 0's coordination service is listening, and a too-fast
+    restart can find the port still in TIME_WAIT — both surface as an
+    immediate initialize() error that a bounded retry absorbs."""
+    import jax
+
+    import logging
+
+    timeout = float(_env("MX_RENDEZVOUS_TIMEOUT", default="300"))
+    deadline = time.monotonic() + timeout
+    delay = 0.5
+    while True:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=n, process_id=rank,
+                initialization_timeout=max(
+                    10, int(deadline - time.monotonic())))
+            return
+        except (TypeError, ValueError):
+            raise  # misconfiguration, deterministic — fail fast, no retry
+        except Exception as e:
+            # jax assigns global_state.client BEFORE client.connect(), so
+            # a failed connect leaves a half-initialized client (and, on
+            # rank 0, a live coordination service) behind; without this
+            # teardown the next attempt dies with "initialize should only
+            # be called once" — and that stale client must NOT be taken
+            # as rendezvous success.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise MXNetError(
+                    f"rendezvous with coordinator {coord} (rank {rank}/{n}) "
+                    f"failed after {timeout:.0f}s — set MX_RENDEZVOUS_TIMEOUT "
+                    f"to extend; last error: {e}") from e
+            logging.getLogger("mxnet_tpu.dist").warning(
+                "rendezvous with %s failed (%s); retrying for another "
+                "%.0fs", coord, e, remaining)
+            time.sleep(min(delay, remaining))
+            delay = min(delay * 2, 10.0)
 
 
 def is_initialized() -> bool:

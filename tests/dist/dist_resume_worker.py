@@ -15,6 +15,17 @@ checkpoint (params + trainer momentum + RNG), verifies it resumed at step
 weights match the uninterrupted baseline — preemption is
 trajectory-invisible.
 
+Phase 3 (MX_RESUME_PHASE=3): the SUPERVISED, hands-off version of phases
+1+2 in one launch.  Run under ``tools/launch.py --max-restarts 1`` with
+``MX_FAULT_SPEC=crash:step=30:rank=1:if-restart=0``: the chaos harness
+kills rank 1 at step 30 on the first incarnation, the survivor takes a
+SIGTERM-triggered final checkpoint (fault.install_preemption_handler), the
+supervisor re-spawns the gang, and the restarted ranks agree on the
+minimum valid checkpoint step (checkpoint.agree_resume_step — the
+preemption checkpoint lands wherever SIGTERM caught rank 0, so ranks WILL
+disagree) before resuming.  Final weights must still match the phase-0
+baseline.
+
 Run via tools/launch.py local mode (the test drives all phases).
 """
 import os
@@ -25,7 +36,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, checkpoint, gluon, nd
+from mxnet_tpu import autograd, checkpoint, fault, gluon, nd
 
 
 def build():
@@ -38,7 +49,7 @@ def build():
 def main():
     phase = int(os.environ["MX_RESUME_PHASE"])
     base = os.environ["MX_RESUME_DIR"]
-    sub = "baseline" if phase == 0 else "resume"
+    sub = {0: "baseline", 3: "supervised"}.get(phase, "resume")
     ckdir = os.path.join(base, sub,
                          f"rank{os.environ.get('MX_PROC_ID', '0')}")
     kv = mx.kv.create("dist_sync")
@@ -53,10 +64,29 @@ def main():
                             {"learning_rate": 0.05, "momentum": 0.9},
                             kvstore=kv)
     loss_fn = gluon.loss.L2Loss()
-    start = checkpoint.restore(ckdir, net, trainer)
-    if phase == 2:
-        assert start == 20, f"expected resume at step 20, got {start}"
-    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=20, keep=2)
+    if phase == 3:
+        # supervised resume: ranks may hold checkpoints at different steps
+        # (a preemption checkpoint lands wherever SIGTERM caught each
+        # rank), so the gang agrees on the minimum valid SCHEDULED step —
+        # the only inventory every rank shares — and restores exactly that
+        local = checkpoint.latest_valid_step(ckdir, multiple_of=20)
+        start = checkpoint.agree_resume_step(local, kv)
+        if start:
+            restored = checkpoint.restore(ckdir, net, trainer, step=start)
+            assert restored == start, (restored, start)
+        restart = int(os.environ.get("MX_RESTART_COUNT", "0"))
+        if restart == 1:
+            assert start == 20, f"expected agreed resume at 20, got {start}"
+        print(f"worker {rank}: incarnation {restart} resuming at step "
+              f"{start}", flush=True)
+    else:
+        start = checkpoint.restore(ckdir, net, trainer)
+        if phase == 2:
+            assert start == 20, f"expected resume at step 20, got {start}"
+    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=20, keep=2,
+                                        initial_step=start)
+    if phase == 3:
+        fault.install_preemption_handler(ckpt, net, trainer=trainer)
 
     total_steps = 120
     for step_i in range(start, total_steps):

@@ -15,9 +15,9 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=240, env=None):
+def _launch(n, script, timeout=240, env=None, launcher_args=()):
     cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
-           "-n", str(n), "--force-cpu", "--",
+           "-n", str(n), "--force-cpu", *launcher_args, "--",
            sys.executable, os.path.join(_REPO, script)]
     return subprocess.run(cmd, cwd=_REPO, timeout=timeout,
                           capture_output=True, text=True, env=env)
@@ -101,6 +101,106 @@ def test_launch_cli_rejects_missing_command():
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"), "-n", "2"],
         capture_output=True, text=True)
     assert res.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# gang supervision (--max-restarts) — chaos tier.  The unit tests use
+# trivial no-jax worker scripts so the supervisor machinery itself gets
+# fast default-tier coverage; the full kill-and-recover training run is
+# the slow e2e at the bottom.
+# ---------------------------------------------------------------------------
+def _run_supervised(tmp_path, script_body, n=2, extra_args=(), timeout=90):
+    worker = tmp_path / "worker.py"
+    worker.write_text(script_body)
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", str(n), "--restart-backoff", "0.05", *extra_args,
+           "--", sys.executable, str(worker)]
+    return subprocess.run(cmd, timeout=timeout, capture_output=True,
+                          text=True)
+
+
+@pytest.mark.chaos
+def test_supervisor_restarts_crashed_gang(tmp_path):
+    """Incarnation 0 crashes rank 1; the supervisor re-spawns the whole
+    gang (fresh MX_RESTART_COUNT) and the retry exits clean."""
+    res = _run_supervised(tmp_path, (
+        "import os, sys\n"
+        "restart = int(os.environ['MX_RESTART_COUNT'])\n"
+        "port = os.environ['MX_COORDINATOR']\n"
+        "print(f\"rank {os.environ['MX_PROC_ID']} incarnation {restart} "
+        "coord {port}\", flush=True)\n"
+        "if restart == 0 and os.environ['MX_PROC_ID'] == '1':\n"
+        "    sys.exit(7)\n"
+    ), extra_args=("--max-restarts", "2"))
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "restarting gang (1/2)" in res.stderr, res.stderr
+    assert res.stdout.count("incarnation 1") == 2, res.stdout
+    # the restarted gang rendezvouses on a FRESH coordinator port
+    import re
+
+    coords = {m.group(1) for m in re.finditer(r"coord (\S+)", res.stdout)}
+    assert len(coords) == 2, coords
+
+
+@pytest.mark.chaos
+def test_supervisor_exhausts_restarts_with_history(tmp_path):
+    res = _run_supervised(tmp_path, (
+        "import os, sys\n"
+        "sys.exit(7 if os.environ['MX_PROC_ID'] == '1' else 0)\n"
+    ), extra_args=("--max-restarts", "1"))
+    assert res.returncode == 7
+    assert "giving up after 2 attempts" in res.stderr, res.stderr
+    assert "per-rank exit history" in res.stderr
+    assert res.stderr.count("rank1=7") == 2, res.stderr
+
+
+@pytest.mark.chaos
+def test_teardown_escalates_to_sigkill(tmp_path):
+    """A rank that ignores SIGTERM (blocked in a native collective) must
+    be SIGKILLed within --term-timeout and REAPED — the launcher may not
+    hang on it (the seed's KeyboardInterrupt path leaked these)."""
+    import time as _time
+
+    t0 = _time.time()
+    res = _run_supervised(tmp_path, (
+        "import os, signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "if os.environ['MX_PROC_ID'] == '0':\n"
+        "    sys.exit(5)\n"
+        "time.sleep(120)\n"
+    ), extra_args=("--term-timeout", "1"), timeout=60)
+    assert res.returncode == 5
+    assert _time.time() - t0 < 30, "SIGKILL escalation failed to reap"
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervised_restart_end_to_end(tmp_path):
+    """The acceptance-criteria scenario, hands-off: rank 1 is killed at
+    step 30 by MX_FAULT_SPEC on the first incarnation, tools/launch.py
+    --max-restarts 1 tears down and re-spawns the gang, the restarted
+    ranks agree on the latest mutually-valid checkpoint (step 20) and the
+    final weights MATCH the uninterrupted baseline."""
+    worker = "tests/dist/dist_resume_worker.py"
+    env = dict(os.environ, MX_RESUME_DIR=str(tmp_path))
+
+    env["MX_RESUME_PHASE"] = "0"  # uninterrupted baseline
+    res0 = _launch(2, worker, env=dict(env))
+    assert res0.returncode == 0, (res0.stdout[-1500:], res0.stderr[-800:])
+
+    env["MX_RESUME_PHASE"] = "3"
+    env["MX_FAULT_SPEC"] = "crash:step=30:rank=1:if-restart=0"
+    res = _launch(2, worker, env=dict(env), timeout=420,
+                  launcher_args=("--max-restarts", "1",
+                                 "--term-timeout", "5",
+                                 "--restart-backoff", "0.2"))
+    assert res.returncode == 0, (res.stdout[-2500:], res.stderr[-1500:])
+    assert "injected crash at step 30" in res.stdout
+    assert "restarting gang (1/1)" in res.stderr
+    assert res.stdout.count("incarnation 1 resuming at step 20") == 2, \
+        res.stdout
+    assert res.stdout.count("resume train OK") == 2, res.stdout
+    assert res.stdout.count("matches uninterrupted baseline") == 2, res.stdout
 
 
 def test_dist_tp_combo_two_workers_parity():

@@ -1,0 +1,386 @@
+"""Chaos-path coverage for the fault-tolerance layer (ISSUE 1): the
+MX_FAULT_SPEC harness, checkpoint integrity digests, fallback-to-older-step
+restore, preemption handling, and the writer-thread lifecycle.
+
+CPU-only and tier-1 fast: the two subprocess tests spawn ONE python each
+(no gang); everything else runs in-process with the harness driven through
+monkeypatched env.  Gang-level supervision lives in test_dist_launch.py.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, fault, gluon, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import AsyncCheckpointer
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+def test_spec_grammar():
+    faults = fault.parse_spec(
+        "crash:step=30:rank=1:if-restart=0; slow-write:ms=500;"
+        "torn-write:step=20:file=meta")
+    assert [f.kind for f in faults] == ["crash", "slow-write", "torn-write"]
+    assert faults[0].step == 30 and faults[0].rank == 1
+    assert faults[0].if_restart == 0
+    assert faults[1].ms == 500
+    assert faults[2].file == "meta"
+    assert fault.parse_spec("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:step=1",          # unknown kind
+    "crash:at=3",              # unknown key
+    "crash:step=soon",         # non-integer
+    "crash",                   # crash requires step=
+    "slow-write:step=3",       # slow-write requires ms=
+    "torn-write:step=3:file=rng",  # bad file target
+])
+def test_spec_rejects_bad_grammar(bad):
+    with pytest.raises(MXNetError, match="MX_FAULT_SPEC"):
+        fault.parse_spec(bad)
+
+
+def test_qualifiers_gate_by_rank_and_incarnation(monkeypatch):
+    monkeypatch.setenv("MX_PROC_ID", "0")
+    monkeypatch.setenv("MX_RESTART_COUNT", "1")
+    assert not fault.parse_spec("crash:step=1:rank=1")[0].applies_here()
+    assert fault.parse_spec("crash:step=1:rank=0")[0].applies_here()
+    assert not fault.parse_spec("crash:step=1:if-restart=0")[0].applies_here()
+    assert fault.parse_spec("crash:step=1:if-restart=1")[0].applies_here()
+    # a crash gated off this rank/incarnation must be a no-op
+    monkeypatch.setenv("MX_FAULT_SPEC", "crash:step=1:rank=1")
+    fault.on_train_step(1)  # would os._exit(57) if it fired
+
+
+# ---------------------------------------------------------------------------
+# in-process training helpers
+# ---------------------------------------------------------------------------
+def _train_setup(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Normal(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    X = np.random.randn(8, 4).astype(np.float32)
+    Y = np.random.randn(8, 1).astype(np.float32)
+    return net, trainer, X, Y
+
+
+def _run_steps(net, trainer, X, Y, n, ckpt):
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(n):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(8)
+        ckpt.step(net, trainer=trainer)
+
+
+def _truncate(path, frac=0.5):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(int(size * frac))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity + fallback
+# ---------------------------------------------------------------------------
+def test_digests_recorded_in_meta(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 5, ckpt)
+    ckpt.close()
+    with open(tmp_path / "step-5" / "meta.json") as f:
+        meta = json.load(f)
+    assert sorted(meta["digests"]) == ["params.nd", "trainer.states"]
+    assert all(len(d) == 64 for d in meta["digests"].values())
+
+
+def test_torn_meta_falls_back_to_previous_step(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    _truncate(tmp_path / "step-10" / "meta.json")
+    assert checkpoint.latest_valid_step(str(tmp_path)) == 5
+    state = checkpoint.load_checkpoint_state(str(tmp_path))
+    assert state["step"] == 5
+    # restore() walks the same fallback — no crash on the torn dir
+    net2, tr2, _, _ = _train_setup(seed=9)
+    assert checkpoint.restore(str(tmp_path), net2, tr2) == 5
+
+
+def test_truncated_params_digest_mismatch_falls_back(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    _truncate(tmp_path / "step-10" / "params.nd")
+    # meta.json parses fine — only the digest check can catch this
+    assert checkpoint.load_checkpoint_state(str(tmp_path))["step"] == 5
+
+
+def test_all_checkpoints_corrupt_returns_none(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    _truncate(tmp_path / "step-5" / "meta.json")
+    _truncate(tmp_path / "step-10" / "params.nd")
+    assert checkpoint.load_checkpoint_state(str(tmp_path)) is None
+    net2, tr2, _, _ = _train_setup(seed=9)
+    assert checkpoint.restore(str(tmp_path), net2, tr2) == 0  # fresh start
+
+
+def test_torn_latest_pointer_is_survivable(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    (tmp_path / "latest").write_text("1")  # torn: half of "10"
+    assert checkpoint.load_checkpoint_state(str(tmp_path))["step"] == 10
+    # step numbering must continue from the dirs, not reset via bad latest
+    ck2 = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    assert ck2._step == 10
+    ck2.close()
+
+
+def test_explicit_step_demand_raises_on_corrupt(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    _truncate(tmp_path / "step-10" / "meta.json")
+    assert checkpoint.load_checkpoint_state(str(tmp_path), step=5)["step"] == 5
+    with pytest.raises(MXNetError, match="missing or corrupt"):
+        checkpoint.load_checkpoint_state(str(tmp_path), step=10)
+
+
+def test_save_now_never_evicts_scheduled_steps(tmp_path):
+    """Off-cycle save_now (preemption) checkpoints must not count against
+    `keep`: rotating a scheduled step away on one rank would make the
+    gang's agreed restore(step=...) raise after a second preemption.  An
+    off-cycle step is itself retained only until the next scheduled write
+    supersedes it, and repeated save_now calls keep only the newest."""
+    def dirs():
+        return sorted((d for d in os.listdir(tmp_path)
+                       if d.startswith("step-")),
+                      key=lambda d: int(d.split("-")[1]))
+
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=2)
+    _run_steps(net, trainer, X, Y, 13, ckpt)  # scheduled: step-5, step-10
+    ckpt.wait()
+    assert ckpt.save_now(net, trainer=trainer) == 13
+    ckpt.close()
+    assert dirs() == ["step-5", "step-10", "step-13"]
+
+    ck2 = AsyncCheckpointer(str(tmp_path), save_every=5, keep=2)
+    _run_steps(net, trainer, X, Y, 1, ck2)  # second preemption at step 14
+    assert ck2.save_now(net, trainer=trainer) == 14
+    # the older off-cycle step-13 is gone, both scheduled steps survive
+    assert dirs() == ["step-5", "step-10", "step-14"]
+    _run_steps(net, trainer, X, Y, 1, ck2)  # step-15: scheduled write
+    ck2.close()
+    # the new scheduled step rotates 5 out and supersedes off-cycle 14
+    assert dirs() == ["step-10", "step-15"]
+
+
+def test_latest_valid_step_scheduled_only(tmp_path):
+    """Gang resume agrees over SCHEDULED steps only: an off-cycle save_now
+    step exists on one rank alone and must not become the agreed step."""
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=2)
+    _run_steps(net, trainer, X, Y, 12, ckpt)  # scheduled 5, 10
+    ckpt.wait()
+    assert ckpt.save_now(net, trainer=trainer) == 12  # off-cycle
+    ckpt.close()
+    assert checkpoint.latest_valid_step(str(tmp_path)) == 12
+    assert checkpoint.latest_valid_step(str(tmp_path), multiple_of=5) == 10
+
+
+def test_explicit_resume_prunes_abandoned_timeline(tmp_path):
+    """Resuming below an off-cycle preemption checkpoint abandons that
+    timeline: the newer dir must be pruned, or rotation would delete the
+    NEXT preemption save in its favor and a later crash would restore
+    state this run never reached."""
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=2)
+    _run_steps(net, trainer, X, Y, 12, ckpt)
+    ckpt.wait()
+    ckpt.save_now(net, trainer=trainer)  # preemption checkpoint step-12
+    ckpt.close()
+    # gang agreed on scheduled step 10; step-12 is an abandoned timeline
+    ck2 = AsyncCheckpointer(str(tmp_path), save_every=5, keep=2,
+                            initial_step=10)
+    assert not (tmp_path / "step-12").exists()
+    assert checkpoint.latest_valid_step(str(tmp_path)) == 10
+    # second preemption at step 11: its save_now must survive as newest
+    _run_steps(net, trainer, X, Y, 1, ck2)
+    assert ck2.save_now(net, trainer=trainer) == 11
+    ck2.close()
+    assert checkpoint.latest_valid_step(str(tmp_path)) == 11
+
+
+def test_agree_resume_step_single_process():
+    assert checkpoint.agree_resume_step(17) == 17
+    assert checkpoint.agree_resume_step(17, kv=None) == 17
+
+
+# ---------------------------------------------------------------------------
+# harness-driven corruption (MX_FAULT_SPEC)
+# ---------------------------------------------------------------------------
+def test_fault_spec_torn_write_then_fallback(tmp_path, monkeypatch):
+    """The acceptance-criteria path: a checkpoint corrupted via
+    MX_FAULT_SPEC=torn-write is skipped in favor of the previous valid
+    step, with no crash in restore()."""
+    monkeypatch.setenv("MX_FAULT_SPEC", "torn-write:step=10")
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=5, keep=3)
+    _run_steps(net, trainer, X, Y, 10, ckpt)
+    ckpt.close()
+    monkeypatch.delenv("MX_FAULT_SPEC")
+    # the harness published step-10 and THEN tore it in place
+    assert (tmp_path / "step-10").is_dir()
+    assert (tmp_path / "latest").read_text() == "10"
+    assert checkpoint.latest_valid_step(str(tmp_path)) == 5
+    net2, tr2, _, _ = _train_setup(seed=9)
+    assert checkpoint.restore(str(tmp_path), net2, tr2) == 5
+
+
+def test_fault_spec_slow_write(tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_FAULT_SPEC", "slow-write:ms=300")
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=1, keep=2)
+    t0 = time.monotonic()
+    _run_steps(net, trainer, X, Y, 1, ckpt)
+    ckpt.close()
+    assert time.monotonic() - t0 >= 0.3
+    assert checkpoint.load_checkpoint_state(str(tmp_path))["step"] == 1
+
+
+_SUBPROC_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, fault, gluon, nd
+
+ckdir, mode = sys.argv[1], sys.argv[2]
+mx.random.seed(0); np.random.seed(0)
+net = gluon.nn.Dense(1); net.initialize(mx.init.Normal(0.5))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {{"learning_rate": 0.05, "momentum": 0.9}})
+loss_fn = gluon.loss.L2Loss()
+X = np.random.randn(8, 4).astype(np.float32)
+Y = np.random.randn(8, 1).astype(np.float32)
+ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=3, keep=3)
+if mode == "preempt":
+    fault.install_preemption_handler(ckpt, net, trainer=trainer)
+for i in range(12):
+    with autograd.record():
+        loss = loss_fn(net(nd.array(X)), nd.array(Y))
+    loss.backward(); trainer.step(8)
+    ckpt.step(net, trainer=trainer)
+    if mode == "preempt" and i == 9:
+        ckpt.wait()
+        open(os.path.join(ckdir, "ready"), "w").close()
+        while True:
+            time.sleep(0.05)
+ckpt.close()
+print("done", flush=True)
+"""
+
+
+def _spawn_worker(tmp_path, mode, extra_env=None):
+    script = tmp_path / "worker.py"
+    script.write_text(_SUBPROC_WORKER.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    return subprocess.Popen(
+        [sys.executable, str(script), str(tmp_path / "ck"), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_crash_mid_write_leaves_tmp_and_recovers(tmp_path):
+    """crash-write:step=6 dies between the payload write and meta.json:
+    the staging .tmp-6 dir survives, step-6 is never published, loads fall
+    back to step-3, and the next checkpointer garbage-collects the tmp."""
+    proc = _spawn_worker(tmp_path, "train",
+                         {"MX_FAULT_SPEC": "crash-write:step=6"})
+    out, err = proc.communicate(timeout=240)
+    assert proc.returncode == fault.EXIT_INJECTED_CRASH, (out, err[-2000:])
+    assert "injected crash mid-write of step 6" in out
+    ckdir = str(tmp_path / "ck")
+    leftovers = [d for d in os.listdir(ckdir) if d.startswith(".tmp-6")]
+    assert leftovers, os.listdir(ckdir)
+    assert not os.path.exists(os.path.join(ckdir, "step-6"))
+    assert checkpoint.load_checkpoint_state(ckdir)["step"] == 3
+    ck = AsyncCheckpointer(ckdir, save_every=3)  # GCs the leftover
+    ck.close()
+    assert not [d for d in os.listdir(ckdir) if d.startswith(".tmp-")]
+
+
+def test_preemption_handler_final_checkpoint(tmp_path):
+    """SIGTERM mid-run => one final synchronous checkpoint at the CURRENT
+    step (not just the last save_every multiple) and exit EXIT_PREEMPTED."""
+    proc = _spawn_worker(tmp_path, "preempt")
+    ready = tmp_path / "ck" / "ready"
+    deadline = time.monotonic() + 240
+    while not ready.exists():
+        assert proc.poll() is None, proc.communicate()
+        assert time.monotonic() < deadline, "worker never became ready"
+        time.sleep(0.1)
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=60)
+    assert proc.returncode == fault.EXIT_PREEMPTED, (out, err[-2000:])
+    assert "final checkpoint at step 10" in out
+    # step 10 is NOT a multiple of save_every=3 — only save_now wrote it
+    state = checkpoint.load_checkpoint_state(str(tmp_path / "ck"))
+    assert state["step"] == 10
+    assert state["trainer"] is not None
+
+
+# ---------------------------------------------------------------------------
+# writer-thread lifecycle (satellite: close() after a writer error)
+# ---------------------------------------------------------------------------
+def test_close_shuts_writer_down_then_reraises(tmp_path):
+    import shutil
+
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path / "sub"), save_every=1)
+    # break the directory out from under the writer
+    shutil.rmtree(str(tmp_path / "sub"))
+    (tmp_path / "sub").write_text("not a dir")
+    _run_steps(net, trainer, X, Y, 1, ckpt)
+    with pytest.raises(MXNetError, match="checkpoint writer failed"):
+        ckpt.close()
+    # the thread was still joined and the sentinel consumed
+    assert not ckpt._writer.is_alive()
+    # idempotent: a second close re-raises without hanging
+    with pytest.raises(MXNetError, match="checkpoint writer failed"):
+        ckpt.close()
+
+
+def test_close_idempotent_on_success(tmp_path):
+    net, trainer, X, Y = _train_setup()
+    ckpt = AsyncCheckpointer(str(tmp_path), save_every=2)
+    _run_steps(net, trainer, X, Y, 2, ckpt)
+    ckpt.close()
+    ckpt.close()
+    assert not ckpt._writer.is_alive()
+    assert checkpoint.load_checkpoint_state(str(tmp_path))["step"] == 2

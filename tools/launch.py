@@ -30,6 +30,12 @@ import sys
 import time
 
 
+# Exit code a worker uses after a SIGTERM-triggered final checkpoint
+# ("clean preemption").  Kept in sync with mxnet_tpu/fault.py EXIT_PREEMPTED
+# by value — this launcher must stay importable without jax/mxnet_tpu.
+EXIT_PREEMPTED = 83
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -38,11 +44,8 @@ def _free_port() -> int:
     return port
 
 
-def launch_local(num_workers: int, command, env_extra=None,
-                 force_cpu: bool = False) -> int:
-    """Spawn num_workers processes of `command` on this host; returns the
-    first non-zero exit code (killing the rest), else 0."""
-    port = _free_port()
+def _spawn_gang(num_workers: int, command, env_extra, force_cpu: bool,
+                port: int, restart_count: int):
     procs = []
     for rank in range(num_workers):
         env = dict(os.environ)
@@ -51,6 +54,10 @@ def launch_local(num_workers: int, command, env_extra=None,
             "MX_COORDINATOR": f"127.0.0.1:{port}",
             "MX_NUM_PROCS": str(num_workers),
             "MX_PROC_ID": str(rank),
+            # which gang incarnation this is (0 = first attempt) — read by
+            # mxnet_tpu.fault's if-restart= qualifier and by worker logic
+            # that must behave differently after a supervised restart
+            "MX_RESTART_COUNT": str(restart_count),
             # reference spellings (kvstore rank/num_workers, user scripts)
             "DMLC_ROLE": "worker",
             "DMLC_PS_ROOT_URI": "127.0.0.1",
@@ -68,31 +75,103 @@ def launch_local(num_workers: int, command, env_extra=None,
             env["PYTHONPATH"] = os.pathsep.join(
                 p for p in pp.split(os.pathsep) if "axon" not in p)
         procs.append(subprocess.Popen(command, env=env))
+    return procs
 
+
+def _terminate_gang(procs, term_timeout: float = 10.0) -> None:
+    """SIGTERM every live worker, wait up to term_timeout for the gang to
+    exit (workers may be writing a final preemption checkpoint), then
+    SIGKILL stragglers.  ALWAYS reaps — no zombies, whether we get here
+    from a worker crash, restart teardown, or KeyboardInterrupt."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.monotonic() + term_timeout
+    for p in procs:
+        if p.poll() is not None:
+            continue
+        try:
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            # a rank blocked in a native collective never sees SIGTERM's
+            # python-level handler; escalate
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:  # pragma: no cover — kill() sent
+            pass
+
+
+def _wait_gang(procs, term_timeout: float) -> int:
+    """Poll ALL workers: a crash in any rank (not just the first) must fan
+    out SIGTERM immediately, or the peers block forever in collectives
+    waiting for the dead rank.  Returns the first non-zero exit code (the
+    *cause*, not the exit of SIGTERMed peers), else 0; all procs reaped."""
     rc = 0
-    try:
-        # poll ALL workers: a crash in any rank (not just the first) must
-        # fan out SIGTERM immediately, or the peers block forever in
-        # collectives waiting for the dead rank
-        alive = list(procs)
-        while alive:
-            for p in list(alive):
-                r = p.poll()
-                if r is None:
-                    continue
-                alive.remove(p)
-                if r != 0 and rc == 0:
-                    rc = r
-                    for q in alive:
-                        q.send_signal(signal.SIGTERM)
-            if alive:
-                time.sleep(0.05)
-    except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGTERM)
-        rc = 130
+    alive = list(procs)
+    while alive:
+        for p in list(alive):
+            r = p.poll()
+            if r is None:
+                continue
+            alive.remove(p)
+            if r != 0 and rc == 0:
+                rc = r
+                _terminate_gang(alive, term_timeout)
+        if alive:
+            time.sleep(0.05)
     return rc
+
+
+def launch_local(num_workers: int, command, env_extra=None,
+                 force_cpu: bool = False, max_restarts: int = 0,
+                 term_timeout: float = 10.0, backoff: float = 1.0) -> int:
+    """Spawn num_workers processes of `command` on this host and supervise
+    the gang: on any worker death the remaining ranks are torn down
+    (SIGTERM, bounded wait, SIGKILL) and — up to max_restarts times — the
+    whole gang is re-spawned on a FRESH coordinator port with exponential
+    backoff, workers resuming from their latest valid checkpoint
+    (docs/FAULT_TOLERANCE.md).  Returns 0, or the last failure's exit code
+    after printing the per-rank exit history."""
+    attempt = 0
+    history = []  # (attempt, [per-rank exit codes])
+    while True:
+        port = _free_port()
+        procs = _spawn_gang(num_workers, command, env_extra, force_cpu,
+                            port, attempt)
+        try:
+            rc = _wait_gang(procs, term_timeout)
+        except KeyboardInterrupt:
+            _terminate_gang(procs, term_timeout)
+            return 130
+        history.append((attempt, [p.returncode for p in procs]))
+        if rc == 0:
+            return 0
+        if attempt >= max_restarts:
+            if max_restarts > 0:
+                print(f"launch.py: giving up after {attempt + 1} attempts; "
+                      "per-rank exit history:", file=sys.stderr)
+                for a, codes in history:
+                    print("  attempt %d: %s" % (a, " ".join(
+                        f"rank{i}={c}" + (
+                            "(preempted)" if c == EXIT_PREEMPTED else "")
+                        for i, c in enumerate(codes))), file=sys.stderr)
+            return rc
+        attempt += 1
+        delay = backoff * (2 ** (attempt - 1))
+        cause = ("worker preempted" if rc == EXIT_PREEMPTED
+                 else "worker died")
+        print(f"launch.py: {cause} (exit {rc}); restarting gang "
+              f"({attempt}/{max_restarts}) on a fresh port in {delay:.1f}s",
+              file=sys.stderr)
+        time.sleep(delay)
 
 
 def main(argv=None) -> int:
@@ -107,6 +186,18 @@ def main(argv=None) -> int:
                     choices=["local", "ssh", "mpi", "sge", "yarn"])
     ap.add_argument("--force-cpu", action="store_true",
                     help="pin workers to the CPU backend (testing)")
+    ap.add_argument("--max-restarts", type=int, default=0, metavar="N",
+                    help="on any worker death, tear the gang down and "
+                         "re-spawn it (fresh coordinator port, exponential "
+                         "backoff) up to N times; workers resume from "
+                         "their latest valid checkpoint")
+    ap.add_argument("--term-timeout", type=float, default=10.0, metavar="S",
+                    help="seconds to wait after SIGTERM before SIGKILL "
+                         "when tearing down a gang (covers the final "
+                         "preemption checkpoint)")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    metavar="S", help="base of the exponential restart "
+                                      "backoff (S, 2S, 4S, ...)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every worker")
     args = ap.parse_args(argv)
@@ -120,7 +211,12 @@ def main(argv=None) -> int:
     if args.num_servers:
         print("launch.py: -s/--num-servers ignored (no PS role on TPU)",
               file=sys.stderr)
-    return launch_local(args.num_workers, command, force_cpu=args.force_cpu)
+    if args.max_restarts < 0:
+        ap.error("--max-restarts must be >= 0")
+    return launch_local(args.num_workers, command, force_cpu=args.force_cpu,
+                        max_restarts=args.max_restarts,
+                        term_timeout=args.term_timeout,
+                        backoff=args.restart_backoff)
 
 
 if __name__ == "__main__":
