@@ -371,6 +371,7 @@ def bench_bert(platform):
         "vs_baseline": round(tok_per_sec / baseline, 4),
         "platform": platform,
         "batch": batch, "seqlen": seqlen,
+        "telemetry": mx.telemetry.summary(),
     }))
 
 
@@ -422,6 +423,7 @@ def bench_transformer(platform):
         "vs_baseline": 0.0,
         "platform": platform,
         "batch": batch, "seqlen": seqlen,
+        "telemetry": mx.telemetry.summary(),
     }))
 
 
@@ -519,6 +521,10 @@ def bench_resnet(platform):
         rec["scan_steps"] = steps
     if os.environ.get("BENCH_REMAT", "0") == "1":
         rec["remat"] = True
+    # per-step telemetry rollup (compile vs exec split, retrace counts,
+    # transfer bytes) rides along with the headline number — the feature
+    # vector a learned cost model trains on
+    rec["telemetry"] = mx.telemetry.summary()
     print(json.dumps(rec))
 
 

@@ -51,6 +51,7 @@ from . import attribute
 from .attribute import AttrScope
 from .monitor import Monitor
 from . import profiler
+from . import telemetry
 from . import runtime
 from . import util
 from .util import is_np_array

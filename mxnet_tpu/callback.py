@@ -36,7 +36,13 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Log training speed + metrics every `frequent` batches (reference ~L100)."""
+    """Log training speed + metrics every `frequent` batches (reference ~L100).
+
+    Speed math uses time.perf_counter(), not time.time(): wall-clock is not
+    monotonic (NTP slews, manual clock steps), and a backwards step across
+    the measurement window produced negative or absurd samples/sec.  When
+    the telemetry recorder is active, each report is also recorded there.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -47,6 +53,8 @@ class Speedometer:
         self.auto_reset = auto_reset
 
     def __call__(self, param):
+        from . import telemetry
+
         count = param.nbatch
         if self.last_count > count:
             self.init = False
@@ -54,7 +62,12 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                speed = (self.frequent * self.batch_size
+                         / (time.perf_counter() - self.tic))
+                if telemetry.enabled():
+                    telemetry.record("speedometer", epoch=param.epoch,
+                                     batch=count,
+                                     samples_per_sec=round(speed, 2))
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -67,10 +80,10 @@ class Speedometer:
                     logging.info(
                         "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                         param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.perf_counter()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.perf_counter()
 
 
 class ProgressBar:

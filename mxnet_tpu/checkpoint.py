@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import fault
+from . import telemetry
 from .base import MXNetError
 
 __all__ = ["AsyncCheckpointer", "load_checkpoint_state", "restore",
@@ -134,6 +135,9 @@ class AsyncCheckpointer:
         # chaos harness: `crash:step=N` dies HERE, before step N's
         # checkpoint can be enqueued — deterministic for tests
         fault.on_train_step(self._step)
+        # the supervisor's liveness signal: rate-limited, atomic-renamed,
+        # no-op without MX_TELEMETRY_DIR
+        telemetry.heartbeat(self._step)
         if self._step % self.save_every != 0:
             return False
         snap = {
@@ -244,6 +248,7 @@ class AsyncCheckpointer:
         from . import ndarray as nd
 
         step = snap["step"]
+        t0 = time.perf_counter()
         fault.on_write_begin(step)
         # thread-unique staging dir: save_now (signal handler, main
         # thread) may race the writer thread on the SAME step when the
@@ -301,6 +306,15 @@ class AsyncCheckpointer:
         for old in drop:
             shutil.rmtree(os.path.join(self.dir, f"step-{old}"),
                           ignore_errors=True)
+        if telemetry.enabled():
+            try:
+                nbytes = sum(os.path.getsize(os.path.join(final, f))
+                             for f in os.listdir(final))
+            except OSError:
+                nbytes = 0
+            telemetry.record_checkpoint(
+                "save", step=step, wall_s=time.perf_counter() - t0,
+                nbytes=nbytes)
         fault.on_write_published(step, final)
 
 
@@ -415,6 +429,7 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
     from .ndarray import utils as nd_utils
 
     explicit = step is not None
+    t0 = time.perf_counter()
     candidates = [int(step)] if explicit else _candidate_steps(directory)
     for s in candidates:
         d = os.path.join(directory, f"step-{s}")
@@ -426,6 +441,8 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
                     "corrupt (demanded via step=)")
             _LOG.warning("checkpoint %s is torn/corrupt; falling back to "
                          "the next-newest step", d)
+            telemetry.record_checkpoint("fallback", step=s,
+                                        reason="digest-or-meta")
             continue
         try:
             params = nd_utils.load(os.path.join(d, "params.nd"))
@@ -436,6 +453,8 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
                     f"{e}") from e
             _LOG.warning("checkpoint %s failed to load (%s); falling back",
                          d, e)
+            telemetry.record_checkpoint("fallback", step=s,
+                                        reason="payload-decode")
             continue
         trainer_states = None
         tpath = os.path.join(d, "trainer.states")
@@ -449,6 +468,8 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
 
             mx_random._state.key = jnp.asarray(
                 np.asarray(meta["rng"], np.uint32))
+        telemetry.record_checkpoint("load", step=s,
+                                    wall_s=time.perf_counter() - t0)
         return {"step": s, "params": params, "trainer": trainer_states,
                 "extra": meta.get("extra", {})}
     return None

@@ -94,6 +94,34 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_RESTART_COUNT": (
         "honored", "gang incarnation index exported by tools/launch.py "
         "--max-restarts; read by fault.py if-restart= and resume logic"),
+    # launcher contract (tools/launch.py exports; parallel/dist.py reads) —
+    # TPU-native spellings of the DMLC_* variables above
+    "MX_COORDINATOR": (
+        "honored", "host:port of the jax.distributed coordination service "
+        "(parallel/dist.py init_from_env)"),
+    "MX_NUM_PROCS": (
+        "honored", "gang process count (parallel/dist.py init_from_env)"),
+    "MX_PROC_ID": (
+        "honored", "this process's gang rank (parallel/dist.py, fault.py "
+        "rank= qualifier, telemetry.py stream naming)"),
+    "MX_FORCE_CPU": (
+        "honored", "pin workers to the CPU jax backend (tools/launch.py "
+        "--force-cpu exports it; parallel/dist.py honors it)"),
+    # runtime telemetry (docs/OBSERVABILITY.md)
+    "MX_TELEMETRY_DIR": (
+        "honored", "enables the telemetry recorder: one rank-<R>.jsonl "
+        "event stream + heartbeat-<R>.json per rank under this directory "
+        "(telemetry.py; polled by tools/launch.py)"),
+    "MX_TELEMETRY_FLUSH_SEC": (
+        "honored", "seconds between background flushes of buffered "
+        "telemetry events to the JSONL sink (telemetry.py; default 1.0)"),
+    "MX_HEARTBEAT_SEC": (
+        "honored", "min seconds between heartbeat-file writes; the "
+        "launch.py supervisor flags a rank stale after 5x this "
+        "(telemetry.py + tools/launch.py; default 5.0)"),
+    "MX_TELEMETRY_RETRACE_LIMIT": (
+        "honored", "distinct jit signatures one executor may accumulate "
+        "before the retrace-storm warning fires (telemetry.py; default 5)"),
 }
 
 _warned = False

@@ -267,9 +267,17 @@ class CachedOp:
     callable per train-mode flag (dropout/BN change the traced program).
     """
 
+    _instance_counter = 0
+
     def __init__(self, block: "HybridBlock", flags: Dict[str, Any]):
         self.block = block
         self.flags = flags
+        # retrace tracking is per-instance: a model holding many
+        # same-class blocks of different widths must not pool their (one
+        # each, perfectly stable) signatures into a false retrace storm
+        CachedOp._instance_counter += 1
+        self._tele_name = (f"CachedOp:{type(block).__name__}"
+                           f"#{CachedOp._instance_counter}")
         # keyed by (train, input treedef): inputs may be arbitrary pytrees of
         # NDArrays (e.g. RNN layers take (x, [h, c]))
         self._jitted: Dict[Any, Any] = {}
@@ -348,6 +356,17 @@ class CachedOp:
         if jfn is None:
             jfn = self._build(cache_key, train, ctx, in_treedef)
             self._jitted[cache_key] = jfn
+
+        # telemetry retrace detection: jax.jit re-traces (and XLA
+        # recompiles) this block for every new input shape/dtype/treedef —
+        # shape-churning data pipelines silently spend their time compiling
+        from .. import telemetry
+
+        if telemetry.retrace_enabled():
+            telemetry.note_signature(
+                self._tele_name,
+                (train, str(in_treedef),
+                 tuple((tuple(x.shape), str(x._data.dtype)) for x in in_nds)))
 
         key = _random.next_key()
         arrays = tuple(p._data for p in param_nds)

@@ -51,6 +51,7 @@ class Trainer:
         self._update_on_kvstore = None
         self._updaters = None
         self._params_to_init: List[Parameter] = []
+        self._step_count = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -127,11 +128,25 @@ class Trainer:
     # ------------------------------------------------------------------
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size, aggregate across devices, update."""
+        import time as _time
+
+        from .. import telemetry
+
+        t0 = _time.perf_counter()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        self._step_count += 1
+        if telemetry.enabled():
+            # first step pays kvstore init + jit compiles of the
+            # reduce/update programs — keep it out of the exec aggregates
+            telemetry.record_step("Trainer", step=self._step_count,
+                                  wall_s=_time.perf_counter() - t0,
+                                  samples=int(batch_size),
+                                  traced=self._step_count == 1)
+            telemetry.heartbeat(self._step_count)
 
     def allreduce_grads(self) -> None:
         if not self._kv_initialized:

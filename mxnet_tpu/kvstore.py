@@ -42,6 +42,7 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._psum_cache: Dict[Any, Any] = {}
+        self._psum_seen: set = set()
         if kv_type.startswith("dist"):
             # rendezvous with the coordination service when launched by
             # tools/launch.py (reference: ps::Postoffice::Start on first
@@ -232,9 +233,12 @@ class KVStore:
         shape = tuple(vals[0].shape)
         key = (tuple(devices), len(shape))
         entry = self._psum_cache.get(key)
+        cold = entry is None
         if entry is None:
+            from .parallel.sharding import shard_map_compat
+
             mesh = Mesh(np.array(devices), ("kv",))
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 lambda x: jax.lax.psum(x, "kv")[0],
                 mesh=mesh, in_specs=P("kv"),
                 out_specs=P(*([None] * len(shape)))))
@@ -247,7 +251,25 @@ class KVStore:
                  for v, d in zip(vals, devices)]
         stacked = jax.make_array_from_single_device_arrays(
             (len(vals),) + shape, NamedSharding(mesh, P("kv")), parts)
+        import time as _time
+
+        from . import telemetry
+
+        t0 = _time.perf_counter()
         reduced = fn(stacked)  # replicated over the kv mesh
+        if telemetry.enabled():
+            # cold = this (devices, ndim) program was jit-built above;
+            # jax also re-specializes per concrete shape — approximate
+            # that with a per-shape first-use check so compile time never
+            # pollutes the comm aggregates
+            shape_key = (key, shape, str(vals[0]._data.dtype))
+            traced = cold or shape_key not in self._psum_seen
+            self._psum_seen.add(shape_key)
+            telemetry.record_collective(
+                "device_allreduce",
+                nbytes=int(np.prod(shape)) * vals[0]._data.dtype.itemsize,
+                wall_s=_time.perf_counter() - t0, ndev=len(vals),
+                traced=traced)
         return NDArray(reduced, ctx=vals[0].context)
 
     def _global_sum(self, nd):

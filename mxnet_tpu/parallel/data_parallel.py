@@ -14,10 +14,12 @@ function via the same parameter-substitution trace the CachedOp uses.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..base import MXNetError
 from .sharding import ShardingRules, replicated, shard_batch
 
@@ -137,6 +139,8 @@ class DataParallelStep:
     optionally); sync_to_block() writes them back into the Gluon parameters.
     """
 
+    _instance_counter = 0
+
     def __init__(self, block, loss_fn: Callable, mesh=None,
                  optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
                  rules: Optional[ShardingRules] = None,
@@ -249,6 +253,11 @@ class DataParallelStep:
 
         if optimizer not in ("sgd", "adam"):
             raise MXNetError(f"fused step supports sgd/adam, got {optimizer}")
+        # per-instance telemetry key: two fused steps over same-class
+        # blocks must not pool retrace signatures (false-storm warnings)
+        DataParallelStep._instance_counter += 1
+        self._tele_name = (f"DataParallelStep:{type(block).__name__}"
+                           f"#{DataParallelStep._instance_counter}")
         self.params = None
         self.opt_state = None
         self._shardings = None
@@ -414,9 +423,22 @@ class DataParallelStep:
         from .. import random as _random
         from ..ndarray import NDArray
 
+        t0 = time.perf_counter()
         datas = tuple(data) if isinstance(data, (tuple, list)) else (data,)
         datas = tuple(d if isinstance(d, NDArray) else NDArray(d, ctx=self._ctx)
                       for d in datas)
+        # retrace detection: jit specializes on input shapes/dtypes, so a
+        # new signature on an already-built step means XLA recompiles —
+        # report it (telemetry warns after the limit) and tag this step's
+        # wall time as compile, not steady-state execute
+        name = self._tele_name
+        if telemetry.retrace_enabled():
+            sig = (tuple((tuple(d.shape), str(d._data.dtype)) for d in datas),
+                   (tuple(np.shape(label)),
+                    np.dtype(getattr(label, "dtype", np.float32)).name))
+            traced = telemetry.note_signature(name, sig)
+        else:  # detection off: still split the first-call compile out
+            traced = self._jitted is None
         if self._accum > 1:
             label_dim0 = (label.shape[0] if hasattr(label, "shape") else
                           np.shape(label)[0])
@@ -522,6 +544,15 @@ class DataParallelStep:
                 np.float32(self._current_lr(self._step_count + 1)),
                 data_arrs, label_arr)
         self._step_count += 1
+        if telemetry.enabled():
+            samples = int(np.shape(label_arr)[0]) if np.ndim(label_arr) else 1
+            xfer = sum(int(getattr(a, "nbytes", 0))
+                       for a in data_arrs + (label_arr,))
+            telemetry.record_step(name, step=self._step_count,
+                                  wall_s=time.perf_counter() - t0,
+                                  samples=samples, transfer_bytes=xfer,
+                                  traced=traced)
+            telemetry.heartbeat(self._step_count)
         return _host_scalar(loss)
 
     def _current_lr(self, num_update: int) -> float:

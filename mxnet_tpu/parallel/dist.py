@@ -116,15 +116,20 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
 
     import logging
 
+    from .. import telemetry
+
     timeout = float(_env("MX_RENDEZVOUS_TIMEOUT", default="300"))
     deadline = time.monotonic() + timeout
     delay = 0.5
+    retries = 0
     while True:
         try:
             jax.distributed.initialize(
                 coordinator_address=coord, num_processes=n, process_id=rank,
                 initialization_timeout=max(
                     10, int(deadline - time.monotonic())))
+            telemetry.record("rendezvous", coordinator=coord, nproc=n,
+                             retries=retries)
             return
         except (TypeError, ValueError):
             raise  # misconfiguration, deterministic — fail fast, no retry
@@ -148,6 +153,9 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
             logging.getLogger("mxnet_tpu.dist").warning(
                 "rendezvous with %s failed (%s); retrying for another "
                 "%.0fs", coord, e, remaining)
+            retries += 1
+            telemetry.record("rendezvous_retry", coordinator=coord,
+                             retries=retries, error=str(e)[:200])
             time.sleep(min(delay, remaining))
             delay = min(delay * 2, 10.0)
 
@@ -180,6 +188,9 @@ def process_count() -> int:
 # (mesh, my lead device, jitted reducer) — built once; jax.jit's own cache
 # handles per-shape/dtype specialization
 _allreduce_state = None
+# (shape, dtype) pairs whose reducer specialization already compiled —
+# telemetry uses this to tag first-use collective events as compile
+_allreduce_seen: set = set()
 
 
 def _get_allreduce_state():
@@ -220,5 +231,18 @@ def allreduce_sum(arr):
         (n,) + tuple(local.shape),
         NamedSharding(mesh, P("hosts")),
         [jax.device_put(local[None], lead)])
+    from .. import telemetry
+
+    t0 = time.perf_counter()
     out = reducer(garr)
+    if telemetry.enabled():
+        # the shared reducer jit re-specializes per (shape, dtype); tag
+        # each first use so compile time stays out of the comm aggregates
+        shape_key = (tuple(local.shape), str(local.dtype))
+        traced = shape_key not in _allreduce_seen
+        _allreduce_seen.add(shape_key)
+        telemetry.record_collective("global_allreduce",
+                                    nbytes=int(local.nbytes),
+                                    wall_s=time.perf_counter() - t0,
+                                    nproc=n, traced=traced)
     return out.addressable_shards[0].data

@@ -19,6 +19,10 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
 _config = {"filename": "profile.json", "profile_all": False,
            "trace_dir": None}
 _running = False
+# one numbered subdirectory per start()/resume() — jax.profiler.start_trace
+# into the SAME directory twice clobbers the first trace, so each segment
+# gets a fresh dir and dump() lists them all
+_segments: list = []
 
 # ---------------------------------------------------------------------------
 # aggregate per-op stats (reference: src/profiler/aggregate_stats.cc — the
@@ -91,7 +95,9 @@ def start():
 
     if _running:
         return
-    jax.profiler.start_trace(_trace_dir())
+    segment = os.path.join(_trace_dir(), f"segment-{len(_segments):03d}")
+    jax.profiler.start_trace(segment)
+    _segments.append(segment)
     _running = True
 
 
@@ -110,6 +116,8 @@ def state():
 
 
 def pause():
+    """Suspend tracing; resume() continues into a FRESH numbered segment
+    (resuming into the same directory clobbered the prior trace)."""
     stop()
 
 
@@ -118,16 +126,23 @@ def resume():
 
 
 def dump(finished=True, profile_process="worker"):
-    """The jax trace is written on stop_trace; this flushes and reports."""
+    """The jax trace is written on stop_trace; this flushes and returns the
+    list of trace segment directories captured so far (one per
+    start()/resume() cycle)."""
     if _running:
         stop()
+    return list(_segments)
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Ranked per-op aggregate table (reference: MXAggregateProfileStatsPrint
     over aggregate_stats.cc) plus the jax trace location.
 
-    format: 'table' (human) or 'json' (machine-readable list of rows)."""
+    format: 'table' (human) or 'json' (machine-readable list of rows).
+    The table form also appends the runtime-telemetry rollup and trace
+    segment list; the json form stays a bare row list for compatibility —
+    machine consumers read the rollup from its first-class API,
+    ``mxnet_tpu.telemetry.summary()``, and segments from ``dump()``."""
     if format not in ("table", "json"):
         raise MXNetError(f"unsupported dumps format {format!r}")
     if not _aggregate:
@@ -137,7 +152,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             return _json.dumps([])
         return (f"profile trace directory: {_trace_dir()}\n"
                 "(no per-op stats recorded — run ops between profiler."
-                "start() and stop())")
+                "start() and stop())" + _telemetry_rollup_lines())
     key = {"total": lambda e: e[1][1], "count": lambda e: e[1][0],
            "avg": lambda e: e[1][1] / e[1][0], "min": lambda e: e[1][2],
            "max": lambda e: e[1][3]}.get(sort_by, lambda e: e[1][1])
@@ -161,9 +176,24 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
             f"{name:<{name_w}}{count:>8}{total * 1e3:>12.3f}"
             f"{total / count * 1e3:>10.3f}{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
     lines.append(f"\nprofile trace directory: {_trace_dir()}")
+    if len(_segments) > 1:
+        lines.append("trace segments: " + ", ".join(_segments))
+    lines.append(_telemetry_rollup_lines().lstrip("\n"))
     if reset:
         reset_stats()
     return "\n".join(lines)
+
+
+def _telemetry_rollup_lines() -> str:
+    """The runtime-telemetry rollup appended to dumps() so one call answers
+    both 'which op is slow' and 'what did the steps/collectives/retraces
+    look like' (docs/OBSERVABILITY.md)."""
+    import json as _json
+
+    from . import telemetry
+
+    return "\n\nTelemetry rollup:\n" + _json.dumps(telemetry.summary(),
+                                                   sort_keys=True)
 
 
 class scope:

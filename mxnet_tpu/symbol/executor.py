@@ -45,6 +45,26 @@ class Executor:
         self._fwd_cache: Dict[bool, Any] = {}
         self._bwd_fn = None
         self._last_train_feed = None
+        self._tele_sigs: Dict[bool, Any] = {}
+
+    _tele_counter = 0
+
+    def _tele_name(self) -> str:
+        """Telemetry key, stored ON the symbol: executors over the same
+        symbol aggregate (the classic storm is a reshape/_simple_bind loop
+        making a fresh executor per ragged batch), distinct symbols never
+        collide, and — unlike keying by id() — a garbage-collected
+        symbol's key can't be inherited by an unrelated new one."""
+        name = getattr(self._symbol, "_tele_name", None)
+        if name is None:
+            Executor._tele_counter += 1
+            name = (f"Executor:{getattr(self._symbol, 'name', None) or 'sym'}"
+                    f"#{Executor._tele_counter}")
+            try:
+                self._symbol._tele_name = name
+            except AttributeError:  # slots/frozen symbol: fall back
+                pass
+        return name
 
     # -- construction helpers ---------------------------------------------
     @classmethod
@@ -95,13 +115,49 @@ class Executor:
         key = self._next_key()
 
         fwd = self._fwd_cache.get(is_train)
+        was_cold = fwd is None
         if fwd is None:
             import jax
 
             fwd = jax.jit(build_graph_eval(self._symbol._entries, is_train))
             self._fwd_cache[is_train] = fwd
 
+        # telemetry: the jit cache is keyed on the feed's shapes/dtypes —
+        # a fresh signature means XLA recompiles this whole graph.  Keyed
+        # by SYMBOL identity, not executor instance: the classic storm is
+        # an Executor.reshape/_simple_bind loop that makes a fresh
+        # executor per ragged batch over the same symbol, and those must
+        # aggregate; distinct models (distinct symbols) must not.
+        import time as _time
+
+        from .. import telemetry
+
+        tele_name = self._tele_name()
+        if telemetry.retrace_enabled():
+            # feed shapes/dtypes are FIXED at bind time (forward() writes
+            # into pre-allocated arrays), so the signature is built once
+            # per (executor, is_train) and reused — the steady-state probe
+            # is a dict hit, not an O(n log n) walk of every param
+            sig = self._tele_sigs.get(is_train)
+            if sig is None:
+                sig = (is_train,
+                       tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                    for n, a in feed.items())))
+                self._tele_sigs[is_train] = sig
+            # OR with was_cold: a SECOND executor over the same symbol
+            # re-jits (and XLA recompiles) even though the symbol-keyed
+            # registry has seen the signature — that compile must not be
+            # booked as steady-state exec
+            traced = telemetry.note_signature(tele_name, sig) or was_cold
+        else:
+            traced = was_cold
+        t0 = _time.perf_counter()
         outs, aux_updates = fwd(feed, key)
+        if telemetry.enabled():
+            self._tele_steps = getattr(self, "_tele_steps", 0) + 1
+            telemetry.record_step(tele_name, step=self._tele_steps,
+                                  wall_s=_time.perf_counter() - t0,
+                                  traced=traced)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         for name, val in aux_updates.items():
             self.aux_dict[name]._set_data(val)

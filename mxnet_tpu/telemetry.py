@@ -1,0 +1,498 @@
+"""Runtime telemetry: step metrics, retrace detection, heartbeats, and a
+flight recorder (docs/OBSERVABILITY.md).
+
+The reference MXNet answers "why is training slow / stuck?" with its
+engine-level profiler brackets (src/profiler/); here whole steps fuse into
+single XLA executables, so the observable unit is the *step*, not the op.
+This module is the process-wide recorder every layer reports into:
+
+  * step events from the compiled executors (``parallel/data_parallel.py``,
+    ``symbol/executor.py``, the Gluon ``Trainer``): wall time, first-call
+    compile vs steady-state execute, samples/sec, host<->device bytes;
+  * **retrace detection**: every executor reports its jit call signature;
+    when one executor accumulates more than ``MX_TELEMETRY_RETRACE_LIMIT``
+    distinct signatures a rate-limited warning names the offending
+    signature — the classic silent 10x slowdown of shape-churning input
+    pipelines (each new shape forces a full XLA recompile);
+  * collective events (op, nbytes, duration) from ``kvstore.py`` and
+    ``parallel/dist.py``;
+  * fault-tolerance lifecycle events (checkpoint save/load durations,
+    digest fallbacks, rendezvous retries, restart count) from
+    ``checkpoint.py`` / ``parallel/dist.py``;
+  * **per-rank heartbeat files** (step + timestamp, atomically renamed)
+    that the ``tools/launch.py`` supervisor polls to diagnose a hung rank
+    *before* killing it.
+
+Disabled (no ``MX_TELEMETRY_DIR``) the recorder no-ops: ``record*()`` and
+``heartbeat()`` return immediately, so the hot step path pays only a
+boolean check.  Retrace *detection* stays on — a microseconds-scale
+signature build + set lookup per executor call — because the warning it
+guards is precisely for runs nobody was watching closely enough to
+enable telemetry on; ``MX_TELEMETRY_RETRACE_LIMIT=0`` switches it off
+entirely (call sites check ``retrace_enabled()`` before building the
+signature).
+
+On-disk layout under ``MX_TELEMETRY_DIR`` (one stream per rank; the
+filename patterns are mirrored in tools/launch.py, which must stay
+importable without jax — keep them in sync)::
+
+    rank-<R>.jsonl        append-only event stream, one JSON object/line:
+                          {"t": <unix sec>, "kind": "...", "rank": R, ...}
+    heartbeat-<R>.json    {"rank": R, "step": S, "time": <unix sec>,
+                          "pid": P, "restart": K} — atomically replaced at
+                          most every MX_HEARTBEAT_SEC seconds
+
+Events buffer in memory (bounded) and a daemon thread flushes them every
+``MX_TELEMETRY_FLUSH_SEC`` seconds; the last ``RING_SIZE`` events also live
+in an in-process ring (the flight recorder) surfaced by ``summary()`` /
+``flight_tail()``.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["enabled", "enable", "disable", "record", "record_step",
+           "record_collective", "heartbeat", "note_signature", "summary",
+           "flight_tail", "flush", "reset", "rank", "event_path",
+           "heartbeat_path", "RING_SIZE"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+# flight-recorder depth (in-process ring; the supervisor reads the JSONL
+# file's tail instead, so this only bounds summary()/flight_tail())
+RING_SIZE = 256
+# force an inline flush when this many events are pending (bounds memory
+# between flusher wakeups under event bursts)
+_FLUSH_PENDING_MAX = 128
+# distinct jit signatures one executor may accumulate before the retrace
+# warning fires (override: MX_TELEMETRY_RETRACE_LIMIT)
+_RETRACE_LIMIT_DEFAULT = 5
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def event_path(directory: str, rank_id: int) -> str:
+    """Per-rank JSONL event stream path (mirrored in tools/launch.py)."""
+    return os.path.join(directory, f"rank-{rank_id}.jsonl")
+
+
+def heartbeat_path(directory: str, rank_id: int) -> str:
+    """Per-rank heartbeat file path (mirrored in tools/launch.py)."""
+    return os.path.join(directory, f"heartbeat-{rank_id}.json")
+
+
+def rank() -> int:
+    """This process's gang rank (0 for single-process runs)."""
+    try:
+        return int(os.environ.get("MX_PROC_ID",
+                                  os.environ.get("DMLC_WORKER_ID", "0")))
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# recorder state
+# ---------------------------------------------------------------------------
+class _State:
+    """All mutable recorder state in one bag so reset() is atomic."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        # serializes the actual file append: flush() may run concurrently
+        # on the daemon flusher, an inline >=128-pending flush, and
+        # atexit — interleaved write(2) calls would tear JSONL lines
+        self.write_lock = threading.Lock()
+        self.dir: Optional[str] = None
+        self.rank: int = 0
+        self.enabled = False
+        self.ring: deque = deque(maxlen=RING_SIZE)
+        self.pending: List[str] = []
+        self.counts: Dict[str, int] = {}
+        # executor -> {count, first_ms, total_ms, samples, bytes}
+        self.steps: Dict[str, Dict[str, float]] = {}
+        self.coll = {"count": 0, "bytes": 0, "total_ms": 0.0,
+                     "compile_ms": 0.0}
+        self.ckpt = {"saves": 0, "save_ms": 0.0, "save_bytes": 0,
+                     "loads": 0, "load_ms": 0.0, "fallbacks": 0}
+        # executor -> {"sigs": set, "traces": int, "warned_at": int,
+        #              "last_sig": str}
+        self.retraces: Dict[str, Dict[str, Any]] = {}
+        self.flusher: Optional[threading.Thread] = None
+        self.flush_sec = 1.0
+        self.hb_interval = 5.0
+        self.hb_last = 0.0
+        self.hb_step = -1
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable(directory: Optional[str] = None) -> None:
+    """Attach the JSONL sink (and heartbeats).  With no argument, reads
+    ``MX_TELEMETRY_DIR``; a missing/empty directory leaves the recorder
+    disabled.  Idempotent; safe to call from any thread."""
+    directory = directory or os.environ.get("MX_TELEMETRY_DIR")
+    if not directory:
+        return
+    with _state.lock:
+        if _state.enabled and _state.dir == directory:
+            return
+        os.makedirs(directory, exist_ok=True)
+        _state.dir = directory
+        _state.rank = rank()
+        _state.flush_sec = max(0.05, _env_float("MX_TELEMETRY_FLUSH_SEC", 1.0))
+        _state.hb_interval = max(0.0, _env_float("MX_HEARTBEAT_SEC", 5.0))
+        _state.enabled = True
+        if _state.flusher is None:
+            _state.flusher = threading.Thread(
+                target=_flusher_loop, name="mx-telemetry-flush", daemon=True)
+            _state.flusher.start()
+    record("start", pid=os.getpid(),
+           restart=int(os.environ.get("MX_RESTART_COUNT", "0") or 0))
+
+
+def disable() -> None:
+    """Detach the sink (pending events are flushed first)."""
+    flush()
+    with _state.lock:
+        _state.enabled = False
+
+
+def reset() -> None:
+    """Drop all aggregates, ring contents, and retrace history (tests)."""
+    global _state
+    flush()
+    with _state.lock:
+        fl = _state.flusher
+        _state = _State()
+        _state.flusher = fl  # one flusher thread per process is plenty
+
+
+def _flusher_loop() -> None:
+    while True:
+        time.sleep(_state.flush_sec)
+        try:
+            flush()
+        except Exception:  # a full disk must not kill the training process
+            pass
+
+
+def flush() -> None:
+    """Append pending events to this rank's JSONL file."""
+    st = _state
+    with st.lock:
+        if not st.pending or st.dir is None:
+            return
+        lines, st.pending = st.pending, []
+        path = event_path(st.dir, st.rank)
+    with st.write_lock:  # whole-batch append; no mid-line interleaving
+        try:
+            with open(path, "a") as f:
+                f.write("".join(lines))
+        except OSError as e:
+            _LOG.warning("telemetry flush to %s failed: %s", path, e)
+
+
+atexit.register(flush)
+
+
+# ---------------------------------------------------------------------------
+# event recording
+# ---------------------------------------------------------------------------
+def record(kind: str, **fields) -> None:
+    """Record one event.  No-op unless the recorder is enabled."""
+    if not _state.enabled:
+        return
+    ev = {"t": round(time.time(), 4), "kind": kind, "rank": _state.rank}
+    ev.update(fields)
+    try:
+        line = json.dumps(ev) + "\n"
+    except (TypeError, ValueError):
+        ev = {k: (v if isinstance(v, (int, float, str, bool, type(None)))
+                  else str(v)) for k, v in ev.items()}
+        line = json.dumps(ev) + "\n"
+    with _state.lock:
+        _state.counts[kind] = _state.counts.get(kind, 0) + 1
+        _state.ring.append(ev)
+        _state.pending.append(line)
+        inline_flush = len(_state.pending) >= _FLUSH_PENDING_MAX
+    if inline_flush:
+        flush()
+
+
+def record_step(executor: str, step: int, wall_s: float,
+                samples: Optional[int] = None, transfer_bytes: int = 0,
+                traced: bool = False, **fields) -> None:
+    """One executor step.  ``traced=True`` marks a first-call/retrace step
+    whose wall time includes trace+compile; those are aggregated separately
+    so steady-state samples/sec is not polluted by compile time.
+
+    ``wall_s`` is the python-side wall of the step call — the recorder
+    deliberately does NOT block_until_ready (forcing a device sync per
+    step would serialize the dispatch pipeline the observability layer is
+    meant to leave undisturbed).  Under async dispatch a single step's
+    wall is dispatch cost, not device time; over a sustained loop the
+    dispatch queue backpressures and per-step walls converge to true step
+    cadence, so the AGGREGATES (mean_exec_ms, samples_per_sec over many
+    steps) are meaningful while the first few per-step numbers undercount.
+    For exact per-program device times use mx.profiler (its timed_call
+    blocks by design)."""
+    if not _state.enabled:
+        return
+    wall_ms = wall_s * 1e3
+    with _state.lock:
+        st = _state.steps.setdefault(executor, {
+            "count": 0, "compile_count": 0, "compile_ms": 0.0,
+            "exec_ms": 0.0, "samples": 0, "bytes": 0})
+        st["count"] += 1
+        if traced:
+            st["compile_count"] += 1
+            st["compile_ms"] += wall_ms
+        else:
+            st["exec_ms"] += wall_ms
+            if samples:
+                st["samples"] += int(samples)
+        st["bytes"] += int(transfer_bytes)
+    ev = dict(executor=executor, step=int(step), wall_ms=round(wall_ms, 3),
+              traced=bool(traced), **fields)
+    if samples is not None:
+        ev["samples"] = int(samples)
+        if wall_s > 0:
+            ev["samples_per_sec"] = round(samples / wall_s, 2)
+    if transfer_bytes:
+        ev["transfer_bytes"] = int(transfer_bytes)
+    record("step", **ev)
+
+
+def record_collective(op: str, nbytes: int, wall_s: float,
+                      traced: bool = False, **fields) -> None:
+    """One collective (kvstore reduce, global allreduce, ...).
+
+    ``traced=True`` marks a first-use call whose wall includes the jit
+    trace + XLA compile of the collective program; it aggregates into
+    ``compile_ms`` so comm cost is never conflated with compile cost."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        _state.coll["count"] += 1
+        _state.coll["bytes"] += int(nbytes)
+        if traced:
+            _state.coll["compile_ms"] += wall_s * 1e3
+        else:
+            _state.coll["total_ms"] += wall_s * 1e3
+    record("collective", op=op, nbytes=int(nbytes),
+           wall_ms=round(wall_s * 1e3, 3), traced=bool(traced), **fields)
+
+
+def record_checkpoint(event: str, step: int, wall_s: float = 0.0,
+                      nbytes: int = 0, **fields) -> None:
+    """Checkpoint lifecycle: event in {save, load, fallback}."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        c = _state.ckpt
+        if event == "save":
+            c["saves"] += 1
+            c["save_ms"] += wall_s * 1e3
+            c["save_bytes"] += int(nbytes)
+        elif event == "load":
+            c["loads"] += 1
+            c["load_ms"] += wall_s * 1e3
+        elif event == "fallback":
+            c["fallbacks"] += 1
+    ev = dict(step=int(step), **fields)
+    if wall_s:
+        ev["wall_ms"] = round(wall_s * 1e3, 3)
+    if nbytes:
+        ev["nbytes"] = int(nbytes)
+    record(f"checkpoint_{event}", **ev)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+def heartbeat(step: int, force: bool = False) -> None:
+    """Write this rank's heartbeat file (atomic rename), rate-limited to
+    one write per ``MX_HEARTBEAT_SEC``.  No-op when telemetry is disabled.
+
+    The reported step is MONOTONIC (max over all reports): several layers
+    heartbeat with their own counters — e.g. after a supervised restart
+    the restored AsyncCheckpointer reports the global step while a fresh
+    Trainer counts from 1 — and the supervisor's "last heartbeat at step
+    S" diagnosis must not flap between them."""
+    if not _state.enabled or _state.dir is None:
+        return
+    now = time.monotonic()
+    with _state.lock:
+        if not force and _state.hb_last and \
+                now - _state.hb_last < _state.hb_interval:
+            return
+        _state.hb_last = now
+        step = _state.hb_step = max(int(step), _state.hb_step)
+        directory, rank_id = _state.dir, _state.rank
+    payload = {"rank": rank_id, "step": int(step),
+               "time": round(time.time(), 3), "pid": os.getpid(),
+               "restart": int(os.environ.get("MX_RESTART_COUNT", "0") or 0)}
+    path = heartbeat_path(directory, rank_id)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # readers never see a torn heartbeat
+    except OSError as e:
+        _LOG.warning("heartbeat write to %s failed: %s", path, e)
+
+
+# ---------------------------------------------------------------------------
+# retrace detection
+# ---------------------------------------------------------------------------
+def _retrace_limit() -> int:
+    try:
+        return int(os.environ.get("MX_TELEMETRY_RETRACE_LIMIT",
+                                  _RETRACE_LIMIT_DEFAULT))
+    except (TypeError, ValueError):
+        return _RETRACE_LIMIT_DEFAULT
+
+
+def retrace_enabled() -> bool:
+    """Retrace detection runs by default (even without a telemetry sink —
+    it exists for runs nobody instrumented); ``MX_TELEMETRY_RETRACE_LIMIT=0``
+    is the kill switch for hot loops where even the per-call signature
+    build must go."""
+    return _retrace_limit() > 0
+
+
+# an executor name past this many registry entries folds into one shared
+# overflow bucket: a script that builds a fresh executor per batch must
+# not grow the registry forever — and since each such instance contributes
+# its (distinct-shaped) first signature to the SAME bucket, the storm the
+# per-instance keys would hide is detected there instead
+_RETRACE_REGISTRY_MAX = 1024
+_OVERFLOW_KEY = "<executor-churn-overflow>"
+
+
+def note_signature(executor: str, signature) -> bool:
+    """Report one executor call's jit signature (shapes/dtypes/static args).
+
+    Returns True when the signature is NEW for this executor — i.e. jax.jit
+    will trace and XLA will compile on this call.  When an executor
+    accumulates more than the retrace limit of distinct signatures, emits a
+    rate-limited warning naming the newest signature (then again only each
+    time the count doubles — a storm logs a handful of lines, not one per
+    step)."""
+    if not retrace_enabled():
+        return False
+    with _state.lock:
+        if (executor not in _state.retraces
+                and len(_state.retraces) >= _RETRACE_REGISTRY_MAX):
+            executor = _OVERFLOW_KEY
+        ent = _state.retraces.setdefault(
+            executor, {"sigs": set(), "traces": 0, "warned_at": 0,
+                       "last_sig": ""})
+        if signature in ent["sigs"]:
+            return False
+        if len(ent["sigs"]) >= 4096:
+            # bounded memory even in a storm: evict one (arbitrary) stored
+            # signature rather than dropping the NEW one — a pipeline that
+            # churns past the cap and then stabilizes must find its final
+            # signature in the set, not be re-counted as a fresh trace
+            # (and re-warned) on every remaining step of the run
+            ent["sigs"].pop()
+        ent["sigs"].add(signature)
+        ent["traces"] += 1
+        # truncate at store time: summary() embeds last_sig verbatim into
+        # bench records and dumps() output — a multi-KB feed signature
+        # must not ride along whole
+        ent["last_sig"] = str(signature)[:400]
+        n = ent["traces"]
+        limit = _retrace_limit()
+        warn = n > limit and (ent["warned_at"] == 0
+                              or n >= 2 * ent["warned_at"])
+        if warn:
+            ent["warned_at"] = n
+    if warn:
+        _LOG.warning(
+            "executor %s has traced %d distinct signatures (retrace limit "
+            "%d); newest: %s.  Every new input shape/dtype forces a full "
+            "XLA recompile — the classic silent 10x slowdown.  Pad or "
+            "bucket inputs to stable shapes (see docs/OBSERVABILITY.md).",
+            executor, n, limit, str(signature)[:400])
+        record("retrace", executor=executor, traces=n,
+               signature=str(signature)[:400])
+    return True
+
+
+# ---------------------------------------------------------------------------
+# rollups
+# ---------------------------------------------------------------------------
+def flight_tail(k: int = 20) -> List[dict]:
+    """The last k events recorded in this process (newest last)."""
+    with _state.lock:
+        return list(_state.ring)[-k:]
+
+
+def summary() -> dict:
+    """JSON-serializable rollup of everything recorded so far.  Works even
+    when the recorder is disabled (retrace tracking is always on)."""
+    with _state.lock:
+        steps = {}
+        for name, st in _state.steps.items():
+            exec_count = st["count"] - st["compile_count"]
+            row = {
+                "count": st["count"],
+                "compile_count": st["compile_count"],
+                "compile_ms": round(st["compile_ms"], 3),
+                "exec_ms": round(st["exec_ms"], 3),
+                "transfer_bytes": st["bytes"],
+            }
+            if exec_count > 0:
+                row["mean_exec_ms"] = round(st["exec_ms"] / exec_count, 3)
+            if st["samples"] and st["exec_ms"] > 0:
+                row["samples_per_sec"] = round(
+                    st["samples"] / (st["exec_ms"] / 1e3), 2)
+            steps[name] = row
+        retraces = {
+            name: {"traces": ent["traces"], "last_signature": ent["last_sig"]}
+            for name, ent in _state.retraces.items()
+        }
+        out = {
+            "enabled": _state.enabled,
+            "rank": _state.rank if _state.enabled else rank(),
+            "dir": _state.dir,
+            "events": dict(_state.counts),
+            "steps": steps,
+            "collectives": {
+                "count": _state.coll["count"],
+                "bytes": _state.coll["bytes"],
+                "total_ms": round(_state.coll["total_ms"], 3),
+                "compile_ms": round(_state.coll["compile_ms"], 3),
+            },
+            "checkpoints": {k: (round(v, 3) if isinstance(v, float) else v)
+                            for k, v in _state.ckpt.items()},
+            "retraces": retraces,
+            "restart_count": int(
+                os.environ.get("MX_RESTART_COUNT", "0") or 0),
+        }
+    return out
+
+
+# attach the sink at import when the launcher/user exported the env
+# (mxnet_tpu/__init__ imports this module; workers inherit the variable
+# from tools/launch.py's environment pass-through)
+enable()

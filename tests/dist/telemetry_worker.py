@@ -1,0 +1,67 @@
+"""Telemetry-under-gang worker (docs/OBSERVABILITY.md acceptance shape):
+2 ranks train a tiny regression net over dist_sync with step-granular
+checkpoints while MX_TELEMETRY_DIR is set; each rank must leave behind a
+parseable rank-<R>.jsonl containing step, collective, and checkpoint
+events, plus a heartbeat file that ADVANCED during the run (verified here
+by re-reading our own heartbeat at two different steps)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd, telemetry
+
+
+def _read_own_heartbeat():
+    path = telemetry.heartbeat_path(os.environ["MX_TELEMETRY_DIR"],
+                                    telemetry.rank())
+    with open(path) as f:
+        return json.load(f)
+
+
+def main():
+    assert telemetry.enabled(), "MX_TELEMETRY_DIR must be set for this worker"
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = X @ np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    lo, hi = rank * (32 // n), (rank + 1) * (32 // n)
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Normal(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    loss_fn = gluon.loss.L2Loss()
+    ckdir = os.path.join(os.environ["MX_TELEMETRY_DIR"], f"ckpt-rank{rank}")
+    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=10, keep=2)
+
+    hb_steps = set()
+    for step_i in range(30):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X[lo:hi])), nd.array(Y[lo:hi]))
+        loss.backward()
+        trainer.step(hi - lo)
+        ckpt.step(net, trainer=trainer)
+        if step_i in (5, 25):
+            # the heartbeat file must ADVANCE while the run is alive
+            time.sleep(0.1)  # outlast a tiny MX_HEARTBEAT_SEC rate limit
+            telemetry.heartbeat(step_i + 1, force=True)
+            hb_steps.add(_read_own_heartbeat()["step"])
+    ckpt.close()
+    telemetry.flush()
+    assert len(hb_steps) >= 2, f"heartbeat never advanced: {hb_steps}"
+    print(f"worker {rank}/{n}: heartbeat advanced {sorted(hb_steps)}",
+          flush=True)
+    print(f"worker {rank}/{n}: telemetry OK loss="
+          f"{float(loss.mean().asnumpy()):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

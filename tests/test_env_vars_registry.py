@@ -1,0 +1,57 @@
+"""Env-var drift guard: every MX_*/MXNET_* variable read anywhere in
+mxnet_tpu/ or tools/ must be registered in mxnet_tpu.env_vars.ENV_VARS.
+
+The registry is the single answer to "is MXNET_X supported here?" — a
+variable consumed at some use-site but absent from the table silently
+drifts out of the documentation, out of `env_vars.check()`'s
+set-but-ineffective warnings, and out of docs/OBSERVABILITY.md's knob
+list.  This test greps the tree so adding an env read without registering
+it fails tier-1 immediately.
+"""
+import os
+import re
+
+from mxnet_tpu import env_vars
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a quoted MX_/MXNET_ name is (by project convention) an env-var use-site:
+# os.environ.get("MX_X"), env_bool("MXNET_Y"), env dicts exported to
+# workers.  Prose mentions in docstrings are unquoted (or backticked), so
+# they don't match.
+_NAME = re.compile(r"""["'](MX(?:NET)?_[A-Z0-9_]+)["']""")
+
+
+def _scan():
+    sites = {}
+    for top in ("mxnet_tpu", "tools"):
+        for dirpath, _dirnames, filenames in os.walk(os.path.join(_REPO, top)):
+            for fname in filenames:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    text = f.read()
+                for m in _NAME.finditer(text):
+                    rel = os.path.relpath(path, _REPO)
+                    sites.setdefault(m.group(1), set()).add(rel)
+    return sites
+
+
+def test_every_env_var_in_tree_is_registered():
+    sites = _scan()
+    assert sites, "scanner found no env vars at all — regex or layout broke"
+    missing = {name: sorted(files) for name, files in sorted(sites.items())
+               if name not in env_vars.ENV_VARS}
+    assert not missing, (
+        "env vars read in the tree but not registered in "
+        "mxnet_tpu/env_vars.py ENV_VARS (add an entry with disposition + "
+        f"use-site): {missing}")
+
+
+def test_registry_covers_telemetry_knobs():
+    # the observability layer's knobs must stay documented
+    for name in ("MX_TELEMETRY_DIR", "MX_TELEMETRY_FLUSH_SEC",
+                 "MX_HEARTBEAT_SEC", "MX_TELEMETRY_RETRACE_LIMIT"):
+        assert name in env_vars.ENV_VARS, name
+        assert env_vars.ENV_VARS[name][0] == "honored", name

@@ -22,18 +22,140 @@ Both MX_* and DMLC_* env spellings are exported to workers.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
+from collections import deque
 
 
 # Exit code a worker uses after a SIGTERM-triggered final checkpoint
 # ("clean preemption").  Kept in sync with mxnet_tpu/fault.py EXIT_PREEMPTED
 # by value — this launcher must stay importable without jax/mxnet_tpu.
 EXIT_PREEMPTED = 83
+
+# flight-recorder events echoed per rank when a gang dies
+FLIGHT_TAIL_EVENTS = 8
+
+
+def _tee(stream, sink, prefix: str) -> None:
+    """Copy worker output to our own stream, one line at a time, with a
+    `[rank N]` prefix so interleaved gang logs stay attributable."""
+    try:
+        for line in iter(stream.readline, ""):
+            sink.write(prefix + line)
+            sink.flush()
+    except ValueError:  # stream closed under us during teardown
+        pass
+    finally:
+        try:
+            stream.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# telemetry (mxnet_tpu/telemetry.py writes these files; the filename
+# patterns are duplicated here because this launcher must stay importable
+# without jax/mxnet_tpu — keep in sync with telemetry.event_path /
+# telemetry.heartbeat_path)
+# ---------------------------------------------------------------------------
+def _flight_tail(tdir: str, rank: int, k: int = FLIGHT_TAIL_EVENTS):
+    """Last k JSONL event lines of a rank's telemetry stream."""
+    path = os.path.join(tdir, f"rank-{rank}.jsonl")
+    try:
+        with open(path, errors="replace") as f:
+            return [line.rstrip("\n") for line in deque(f, maxlen=k)]
+    except OSError:
+        return []
+
+
+class _HeartbeatMonitor:
+    """Poll per-rank heartbeat files so a hung/slow rank is diagnosed
+    ("rank 2 last heartbeat 45s ago at step 130") BEFORE the gang is torn
+    down, and echo each rank's flight-recorder tail after a failure.
+    Inert when MX_TELEMETRY_DIR is unset."""
+
+    def __init__(self, num_workers: int, env_extra=None):
+        # workers see env_extra OVERLAID on our environ (_spawn_gang), so
+        # the monitor must resolve the telemetry config the same way — a
+        # programmatic launch_local(env_extra={"MX_TELEMETRY_DIR": ...})
+        # must not leave the supervisor blind
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        self.dir = env.get("MX_TELEMETRY_DIR") or None
+        try:
+            hb = float(env.get("MX_HEARTBEAT_SEC", "5") or 5.0)
+        except ValueError:
+            hb = 5.0
+        # several missed beats = stale; floor keeps sub-second test
+        # configs from flagging healthy ranks on a loaded host
+        self.stale_after = max(2.0, 5.0 * hb)
+        self.num = num_workers
+        self._stale = set()
+        self._next_poll = 0.0
+        self._gang_start = 0.0
+
+    def gang_started(self) -> None:
+        """Called at each (re)spawn: heartbeats older than this incarnation
+        are leftovers of the previous gang, not evidence of a hung rank."""
+        self._gang_start = time.time()
+        self._stale.clear()
+
+    def _read(self, rank: int):
+        try:
+            with open(os.path.join(self.dir,
+                                   f"heartbeat-{rank}.json")) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return rec if isinstance(rec, dict) else None
+
+    def poll(self) -> None:
+        """Called from the supervision loop while the gang is alive;
+        reports each staleness episode once (and recovery resets it)."""
+        if self.dir is None:
+            return
+        now = time.monotonic()
+        if now < self._next_poll:
+            return
+        self._next_poll = now + max(1.0, self.stale_after / 4.0)
+        for rank in range(self.num):
+            rec = self._read(rank)
+            if rec is None:
+                continue  # not started yet / no telemetry in the worker
+            if float(rec.get("time", 0.0)) < self._gang_start:
+                continue  # previous incarnation's heartbeat
+            age = time.time() - float(rec.get("time", 0.0))
+            if age > self.stale_after:
+                if rank not in self._stale:
+                    self._stale.add(rank)
+                    print(f"launch.py: rank {rank} last heartbeat "
+                          f"{age:.1f}s ago at step {rec.get('step')} — "
+                          "suspect hung/slow rank", file=sys.stderr)
+            else:
+                self._stale.discard(rank)
+
+    def diagnose(self) -> None:
+        """After a gang death: last heartbeat per rank + flight tail."""
+        if self.dir is None:
+            return
+        for rank in range(self.num):
+            rec = self._read(rank)
+            if rec is not None:
+                age = time.time() - float(rec.get("time", 0.0))
+                print(f"launch.py: rank {rank} last heartbeat {age:.1f}s "
+                      f"ago at step {rec.get('step')}", file=sys.stderr)
+            tail = _flight_tail(self.dir, rank)
+            if tail:
+                print(f"launch.py: flight recorder tail (rank {rank}, "
+                      f"last {len(tail)} events):", file=sys.stderr)
+                for line in tail:
+                    print(f"  {line}", file=sys.stderr)
 
 
 def _free_port() -> int:
@@ -46,10 +168,18 @@ def _free_port() -> int:
 
 def _spawn_gang(num_workers: int, command, env_extra, force_cpu: bool,
                 port: int, restart_count: int):
+    """Spawn the gang with piped stdout/stderr, teeing every line to our
+    own streams under a `[rank N]` prefix.  Returns (procs, tee_threads).
+
+    PYTHONUNBUFFERED keeps worker output line-granular through the pipe —
+    a SIGKILLed rank must not take its last (block-buffered) lines of
+    diagnosis down with it."""
     procs = []
+    tees = []
     for rank in range(num_workers):
         env = dict(os.environ)
         env.update(env_extra or {})
+        env["PYTHONUNBUFFERED"] = "1"
         env.update({
             "MX_COORDINATOR": f"127.0.0.1:{port}",
             "MX_NUM_PROCS": str(num_workers),
@@ -74,8 +204,17 @@ def _spawn_gang(num_workers: int, command, env_extra, force_cpu: bool,
             pp = env.get("PYTHONPATH", "")
             env["PYTHONPATH"] = os.pathsep.join(
                 p for p in pp.split(os.pathsep) if "axon" not in p)
-        procs.append(subprocess.Popen(command, env=env))
-    return procs
+        p = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             errors="replace", bufsize=1)
+        procs.append(p)
+        for stream, sink in ((p.stdout, sys.stdout), (p.stderr, sys.stderr)):
+            t = threading.Thread(target=_tee,
+                                 args=(stream, sink, f"[rank {rank}] "),
+                                 daemon=True)
+            t.start()
+            tees.append(t)
+    return procs, tees
 
 
 def _terminate_gang(procs, term_timeout: float = 10.0) -> None:
@@ -109,11 +248,13 @@ def _terminate_gang(procs, term_timeout: float = 10.0) -> None:
             pass
 
 
-def _wait_gang(procs, term_timeout: float) -> int:
+def _wait_gang(procs, term_timeout: float, monitor=None) -> int:
     """Poll ALL workers: a crash in any rank (not just the first) must fan
     out SIGTERM immediately, or the peers block forever in collectives
     waiting for the dead rank.  Returns the first non-zero exit code (the
-    *cause*, not the exit of SIGTERMed peers), else 0; all procs reaped."""
+    *cause*, not the exit of SIGTERMed peers), else 0; all procs reaped.
+    `monitor` (a _HeartbeatMonitor) is polled so a stale rank is called
+    out while the gang still looks alive."""
     rc = 0
     alive = list(procs)
     while alive:
@@ -126,6 +267,8 @@ def _wait_gang(procs, term_timeout: float) -> int:
                 rc = r
                 _terminate_gang(alive, term_timeout)
         if alive:
+            if monitor is not None:
+                monitor.poll()
             time.sleep(0.05)
     return rc
 
@@ -142,18 +285,25 @@ def launch_local(num_workers: int, command, env_extra=None,
     after printing the per-rank exit history."""
     attempt = 0
     history = []  # (attempt, [per-rank exit codes])
+    monitor = _HeartbeatMonitor(num_workers, env_extra)
     while True:
         port = _free_port()
-        procs = _spawn_gang(num_workers, command, env_extra, force_cpu,
-                            port, attempt)
+        monitor.gang_started()
+        procs, tees = _spawn_gang(num_workers, command, env_extra, force_cpu,
+                                  port, attempt)
         try:
-            rc = _wait_gang(procs, term_timeout)
+            rc = _wait_gang(procs, term_timeout, monitor)
         except KeyboardInterrupt:
             _terminate_gang(procs, term_timeout)
             return 130
+        # drain the tee threads so every worker line lands BEFORE the
+        # supervisor's own diagnosis/history output
+        for t in tees:
+            t.join(timeout=5.0)
         history.append((attempt, [p.returncode for p in procs]))
         if rc == 0:
             return 0
+        monitor.diagnose()
         if attempt >= max_restarts:
             if max_restarts > 0:
                 print(f"launch.py: giving up after {attempt + 1} attempts; "
