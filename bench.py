@@ -114,14 +114,24 @@ def probe_main():
                       "platforms": sorted({d.platform for d in devs})}))
 
 
-def _probe_tpu(history, use_cache=False, attempts=None):
+def _probe_tpu(history, use_cache=False, attempts=None,
+               honor_negative_cache=False):
     """Run the probe subprocess with retries.  Returns True if a non-cpu
     backend answered within the timeout.  Every real probe refreshes the
-    session cache; use_cache=True short-circuits on a cached verdict
-    (tests/tools), while the driver bench always probes for real."""
-    if use_cache:
+    session cache; use_cache=True short-circuits on any cached verdict
+    (tests/tools); honor_negative_cache=True short-circuits on a fresh
+    NEGATIVE verdict only (the driver bench: a dead relay costs one probe
+    per session, but a positive answer is always re-verified) while
+    use_cache=False callers like tools/relay_watch.py still probe raw.
+
+    A HANG (subprocess timeout) writes the negative verdict immediately
+    and skips the remaining backoff attempts: BENCH_r05 burned three
+    identical 90s hang-probes (270s) before the CPU fallback, and the
+    wedge failure mode has never been observed to recover within one
+    invocation — only quick crashes get the retry ladder."""
+    if use_cache or honor_negative_cache:
         rec = read_probe_cache()
-        if rec is not None:
+        if rec is not None and (use_cache or not rec["alive"]):
             history.append({"cached": True, "alive": rec["alive"],
                             "age_s": round(time.time() - rec.get("t", 0), 1)})
             return rec["alive"]
@@ -158,6 +168,11 @@ def _probe_tpu(history, use_cache=False, attempts=None):
         except subprocess.TimeoutExpired:
             history.append({"attempt": attempt, "ok": False,
                             "s": round(time.time() - t0, 1), "why": "hang"})
+            # a wedge is definitive like the cpu-only answer above: record
+            # full-ladder-strength evidence so the verdict keeps the whole
+            # TTL (attempts=1 would demote it to the weak 1/3-TTL tier)
+            write_probe_cache(False, "hang", attempts=attempts)
+            return False
         if attempt < attempts - 1 and attempt < len(PROBE_BACKOFFS):
             time.sleep(PROBE_BACKOFFS[attempt])
     write_probe_cache(False, history[-1].get("why", "") if history else "",
@@ -223,7 +238,7 @@ def _session_tpu_artifact(model):
 
 def main():
     history = []
-    on_tpu = _probe_tpu(history)
+    on_tpu = _probe_tpu(history, honor_negative_cache=True)
     result = None
     if on_tpu:
         result = _run_child("tpu", RUN_TIMEOUT_TPU, history)
@@ -286,6 +301,23 @@ def main():
                 if sec_art is not None:
                     sec["tpu_artifact"] = sec_art
             result["secondary"] = sec
+            print(json.dumps(result), flush=True)
+
+    # trainer_step_overhead: fused-vs-per-param Trainer.step dispatch win
+    # on a fixed 50-param toy net.  Host-dispatch-bound by construction, so
+    # it always measures on CPU — the number tracks the O(n_params)->O(1)
+    # collapse (docs/PERFORMANCE.md) in the bench trajectory rather than
+    # leaving it claimed.  Rides the same merged-record contract as the
+    # BERT secondary: the last parseable line is authoritative.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_TRAINER_OVERHEAD", "1") != "0"
+            and "error" not in result):
+        ovh = _run_child("cpu", float(os.environ.get(
+            "BENCH_TRAINER_OVERHEAD_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "trainer_overhead"})
+        if ovh is not None:
+            ovh.pop("probe_history", None)
+            result["trainer_step_overhead"] = ovh
             print(json.dumps(result), flush=True)
 
 
@@ -528,12 +560,79 @@ def bench_resnet(platform):
     print(json.dumps(rec))
 
 
+def bench_trainer_overhead(platform):
+    """Secondary metric: Trainer.step() dispatch overhead — steps/sec on a
+    fixed 50-param toy net with the fused optimizer apply on vs off
+    (MX_FUSED_UPDATE).  Gradients are computed once and held fixed; the
+    loop times ONLY the step path (allreduce + update dispatch), which is
+    exactly where the per-param O(n_params) storm lived."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+
+    n_layers = 25  # Dense weight+bias each -> 50 params
+    steps = int(os.environ.get("BENCH_OVERHEAD_STEPS", 100))
+    trials = int(os.environ.get("BENCH_OVERHEAD_TRIALS", 5))
+
+    def steps_per_sec(fused):
+        import jax
+
+        from mxnet_tpu import autograd, gluon, nd
+        from mxnet_tpu.gluon import nn
+
+        os.environ["MX_FUSED_UPDATE"] = "1" if fused else "0"
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(n_layers):
+                net.add(nn.Dense(4))
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 1e-3, "momentum": 0.9})
+        x = nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32),
+                     ctx=ctx)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        params = list(net.collect_params().values())
+        for _ in range(3):  # warmup: kvstore/state init + update compiles
+            trainer.step(2)
+        jax.block_until_ready([p.data()._data for p in params])
+        # best-of-`trials` (as _timed_steps): a 2-vCPU box's scheduling
+        # noise swings single-trial dispatch timings several-x; the best
+        # trial is the uncontended dispatch cost the metric is after
+        best = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                trainer.step(2)
+            jax.block_until_ready([p.data()._data for p in params])
+            best = min(best, time.perf_counter() - t0)
+        return steps / best
+
+    per_param = steps_per_sec(False)
+    fused = steps_per_sec(True)
+    print(json.dumps({
+        "metric": "trainer_step_overhead",
+        "value": round(fused / per_param, 3) if per_param else 0.0,
+        "unit": "x_fused_vs_per_param",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "fused_steps_per_sec": round(fused, 2),
+        "per_param_steps_per_sec": round(per_param, 2),
+        "n_params": 2 * n_layers,
+        "steps": steps,
+    }))
+
+
 def child_main(platform):
     model = os.environ.get("BENCH_MODEL", "resnet")
     if model == "bert":
         bench_bert(platform)
     elif model == "transformer":
         bench_transformer(platform)
+    elif model == "trainer_overhead":
+        bench_trainer_overhead(platform)
     else:
         bench_resnet(platform)
 

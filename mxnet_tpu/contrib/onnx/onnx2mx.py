@@ -392,6 +392,23 @@ class _Importer:
         self.set_out(node, [out])
 
     def op_Split(self, node, attrs, ins):
+        sizes = attrs.get("split")
+        if sizes is None and len(node["input"]) >= 2 and node["input"][1] \
+                and node["input"][1] in self.init:
+            # opset 13+: split sizes arrive as a second input rather than
+            # an attribute; validate when statically known (initializer or
+            # Constant) — runtime-computed sizes keep the legacy
+            # even-split import
+            sizes = [int(s) for s in
+                     np.asarray(self.const(node["input"][1])).flatten()]
+        if sizes and len(set(int(s) for s in sizes)) > 1:
+            # SliceChannel only emits equal parts; importing an uneven
+            # split as an even one would silently produce wrong shapes
+            raise MXNetError(
+                f"ONNX Split node {self._name(node)!r}: uneven split "
+                f"sizes {[int(s) for s in sizes]} are not supported "
+                "(SliceChannel emits equal parts only); re-export the "
+                "model with equal splits")
         out = self.sym().SliceChannel(
             ins[0], num_outputs=len(node["output"]),
             axis=int(attrs.get("axis", 0)), name=self._name(node))
@@ -403,8 +420,19 @@ class _Importer:
             name=self._name(node))])
 
     def op_Constant(self, node, attrs, ins):
-        t = attrs["value"]
         name = node["output"][0]
+        t = attrs.get("value")
+        if t is None:
+            # ONNX allows value_float/value_int/value_floats/... variants;
+            # only the tensor form is supported — name the form found
+            # instead of dying with a bare KeyError
+            present = sorted(k for k in attrs if k.startswith("value")
+                             or k == "sparse_value")
+            raise MXNetError(
+                f"ONNX Constant node {name!r}: only the tensor-valued "
+                f"`value` attribute is supported, got "
+                f"{present or sorted(attrs)}; re-export the constant as "
+                "a tensor")
         self.init[name] = t["array"]
         # materialized lazily (as a param or via const()) on first use
 

@@ -107,6 +107,16 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_FORCE_CPU": (
         "honored", "pin workers to the CPU jax backend (tools/launch.py "
         "--force-cpu exports it; parallel/dist.py honors it)"),
+    # fused optimizer apply + bucketed allreduce (docs/PERFORMANCE.md)
+    "MX_FUSED_UPDATE": (
+        "honored", "0 disables the fused optimizer apply (one jitted "
+        "update call for all dense params) and pins the per-param "
+        "Updater path (optimizer/fused.py get_updater)"),
+    "MX_ALLREDUCE_BUCKET_MB": (
+        "honored", "gradient-allreduce bucket cap in MB (default 32): "
+        "per-param pushpulls coalesce into flat buckets this large so "
+        "one collective moves many grads; 0 disables bucketing "
+        "(parallel/dist.py bucket_cap_bytes, kvstore.py push_bucketed)"),
     # runtime telemetry (docs/OBSERVABILITY.md)
     "MX_TELEMETRY_DIR": (
         "honored", "enables the telemetry recorder: one rank-<R>.jsonl "

@@ -52,6 +52,7 @@ class Trainer:
         self._updaters = None
         self._params_to_init: List[Parameter] = []
         self._step_count = 0
+        self._last_n_buckets = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -136,6 +137,8 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        for upd in self._fused_updaters():
+            upd.last_info = None
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         self._step_count += 1
@@ -146,7 +149,36 @@ class Trainer:
                                   wall_s=_time.perf_counter() - t0,
                                   samples=int(batch_size),
                                   traced=self._step_count == 1)
+            info = {"n_params": 0, "n_fused": 0, "nbytes": 0,
+                    "n_jitted_calls": 0}
+            for upd in self._fused_updaters():
+                li = upd.last_info
+                if li:
+                    # per-device updaters each saw the same param replicas:
+                    # count params/bytes once, but dispatches per device
+                    info["n_params"] = max(info["n_params"],
+                                           li.get("n_params", 0))
+                    info["nbytes"] = max(info["nbytes"], li.get("nbytes", 0))
+                    info["n_fused"] += li.get("n_fused", 0)
+                    info["n_jitted_calls"] += li.get("n_jitted_calls", 0)
+            if info["n_fused"]:
+                telemetry.record_fused_update(
+                    n_params=info["n_params"],
+                    n_buckets=self._last_n_buckets,
+                    nbytes=info["nbytes"],
+                    n_jitted_calls=info["n_jitted_calls"],
+                    step=self._step_count)
             telemetry.heartbeat(self._step_count)
+
+    def _fused_updaters(self):
+        """Every FusedUpdater this trainer's step can route through — its
+        own per-device updaters, or the kvstore's server-side one."""
+        from ..optimizer.fused import FusedUpdater
+
+        upds = list(self._updaters or [])
+        if self._kvstore is not None and self._kvstore._updater is not None:
+            upds.append(self._kvstore._updater)
+        return [u for u in upds if isinstance(u, FusedUpdater)]
 
     def allreduce_grads(self) -> None:
         if not self._kv_initialized:
@@ -158,13 +190,21 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self) -> None:
+        self._last_n_buckets = 0
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad())
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad())
+        live = [(i, param) for i, param in enumerate(self._params)
+                if param.grad_req != "null"]
+        if not live:
+            return
+        # size-capped flat buckets move many grads per collective;
+        # push_bucketed itself falls back to per-key pushes when bucketing
+        # is disabled, and unflattens before the store so pull is unchanged
+        self._last_n_buckets = self._kvstore.push_bucketed(
+            [i for i, _p in live], [p.list_grad() for _i, p in live])
+        if not self._update_on_kvstore:
+            for i, param in live:
+                self._kvstore.pull(i, param.list_grad())
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         if not self._kv_initialized:
@@ -177,6 +217,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False) -> None:
+        from ..optimizer.fused import FusedUpdater
+
+        entries_per_dev = [[] for _ in (self._updaters or [])]
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -187,9 +230,19 @@ class Trainer:
                 # server updated the stored weight during push; fetch it
                 self._kvstore.pull(i, param.list_data())
                 continue
-            for upd, w, g in zip(self._updaters, param.list_data(),
-                                 param.list_grad()):
-                upd(i, g, w)
+            for entries, w, g in zip(entries_per_dev, param.list_data(),
+                                     param.list_grad()):
+                entries.append((i, g, w))
+        if self._update_on_kvstore:
+            return
+        for upd, entries in zip(self._updaters, entries_per_dev):
+            if isinstance(upd, FusedUpdater):
+                # the trainer owns its parameter buffers — donate them so
+                # XLA updates in place (no-op on the CPU backend)
+                upd.apply(entries, donate=True)
+            else:
+                for i, g, w in entries:
+                    upd(i, g, w)
 
     # ------------------------------------------------------------------
     def save_states(self, fname: str) -> None:

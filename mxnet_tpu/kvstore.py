@@ -94,19 +94,7 @@ class KVStore:
             merged = self._reduce(_as_list(v))
             if self._type.startswith("dist") and self.num_workers > 1:
                 merged = self._global_sum(merged)
-            if self._updater is not None:
-                if k not in self._store:
-                    raise MXNetError(f"key {k} not initialized")
-                # the updater computes eagerly on one device — localize
-                # BOTH operands (a mesh-replicated merge from a collective
-                # reduce, and a store value left replicated by an earlier
-                # non-updater push) so eager ops don't mix device sets
-                ctx = self._store[k].context
-                merged = self._localize(merged, ctx)
-                self._store[k] = self._localize(self._store[k], ctx)
-                self._updater(self._updater_key(k), merged, self._store[k])
-            else:
-                self._store[k] = merged
+            self._store_merged([(k, merged)])
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
@@ -121,6 +109,128 @@ class KVStore:
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    # ------------------------------------------------------------------
+    # bucketed gradient aggregation (docs/PERFORMANCE.md)
+    # ------------------------------------------------------------------
+    def push_bucketed(self, key, value, priority: int = 0) -> int:
+        """Push many keys at once, coalescing their values into size-capped
+        flat buckets (MX_ALLREDUCE_BUCKET_MB, default 32) so ONE collective
+        moves many gradients instead of one per key.  Store contents after
+        the call are exactly what per-key ``push`` would have produced
+        (unflatten restores every key before it reaches the store or the
+        updater), so ``pull`` semantics are unchanged.
+
+        Returns the number of flat buckets reduced; 0 means everything fell
+        back to per-key pushes (bucketing disabled, or sparse/ragged
+        values).  When the installed updater is a ``FusedUpdater`` the
+        server-side optimizer also applies in one jitted call for the whole
+        batch rather than once per key.
+        """
+        from .parallel.dist import bucket_cap_bytes
+
+        keys, values = self._key_value(key, value)
+        cap = bucket_cap_bytes()
+        if cap <= 0:
+            for k, v in zip(keys, values):
+                self.push(k, v, priority)
+            return 0
+        from .ndarray.sparse import BaseSparseNDArray
+
+        groups: Dict[Any, List] = {}  # (ctx tuple, dtype) -> [(k, vals)]
+        fallback: List = []
+        for k, v in zip(keys, values):
+            vals = _as_list(v)
+            lead = vals[0]
+            if (any(isinstance(x, BaseSparseNDArray) for x in vals)
+                    or any(x._data.dtype != lead._data.dtype
+                           or x.shape != lead.shape for x in vals[1:])):
+                fallback.append((k, vals))
+                continue
+            gkey = (tuple(x.context for x in vals), str(lead._data.dtype))
+            groups.setdefault(gkey, []).append((k, vals))
+        n_buckets = 0
+        merged_kv: List = []  # (k, merged NDArray) in caller key order
+        for (_ctxs, _dt), items in groups.items():
+            bucket: List = []
+            nbytes = 0
+            for k, vals in items:
+                sz = int(vals[0].size) * vals[0]._data.dtype.itemsize
+                if bucket and nbytes + sz > cap:
+                    merged_kv.extend(self._reduce_bucket(bucket))
+                    n_buckets += 1
+                    bucket, nbytes = [], 0
+                bucket.append((k, vals))
+                nbytes += sz
+            if bucket:
+                merged_kv.extend(self._reduce_bucket(bucket))
+                n_buckets += 1
+        self._store_merged(merged_kv)
+        for k, vals in fallback:
+            self.push(k, vals, priority)
+        return n_buckets
+
+    def _reduce_bucket(self, bucket) -> List:
+        """Reduce one flat bucket across devices (and hosts for dist_*);
+        returns the per-key merged values, unflattened."""
+        from .ndarray import NDArray
+        from .parallel.dist import flatten_bucket, unflatten_bucket
+
+        shapes = [tuple(vals[0].shape) for _k, vals in bucket]
+        if len(bucket) == 1:
+            # a bucket of one key gains nothing from the flatten round-trip
+            k, vals = bucket[0]
+            merged = self._reduce(vals)
+            if self._type.startswith("dist") and self.num_workers > 1:
+                merged = self._global_sum(merged)
+            return [(k, merged)]
+        ndev = len(bucket[0][1])
+        flats = []
+        for d in range(ndev):
+            flat = flatten_bucket([vals[d]._data for _k, vals in bucket])
+            flats.append(NDArray(flat, ctx=bucket[0][1][d].context))
+        merged = self._reduce(flats)
+        if self._type.startswith("dist") and self.num_workers > 1:
+            merged = self._global_sum(merged)
+        segments = unflatten_bucket(merged._data, shapes)
+        return [(k, NDArray(seg, ctx=merged.context))
+                for (k, _vals), seg in zip(bucket, segments)]
+
+    def _store_merged(self, merged_kv) -> None:
+        """The tail of ``push`` for already-reduced values: store them, or
+        hand them to the server-side optimizer — batched through the fused
+        updater when several keys arrive at once (the bucketed path)."""
+        if self._updater is None:
+            for k, merged in merged_kv:
+                self._store[k] = merged
+            return
+        entries = []
+        for k, merged in merged_kv:
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            # the updater computes eagerly on one device — localize BOTH
+            # operands (a mesh-replicated merge from a collective reduce,
+            # and a store value left replicated by an earlier non-updater
+            # push) so eager ops don't mix device sets
+            ctx = self._store[k].context
+            merged = self._localize(merged, ctx)
+            self._store[k] = self._localize(self._store[k], ctx)
+            entries.append((self._updater_key(k), merged, self._store[k]))
+        apply_batch = None
+        if len(entries) > 1:
+            from .optimizer.fused import FusedUpdater
+
+            # scope the batched fast path to the type that defines it — a
+            # user updater installed via set_updater may coincidentally
+            # have an `apply` with a different contract
+            if isinstance(self._updater, FusedUpdater):
+                apply_batch = self._updater.apply
+        if apply_batch is not None:
+            # donate=False: pulled store values alias into caller arrays
+            apply_batch(entries)
+        else:
+            for uk, merged, stored in entries:
+                self._updater(uk, merged, stored)
 
     def broadcast(self, key, value, out, priority: int = 0) -> None:
         self.init(key, value)

@@ -198,11 +198,30 @@ class Module(BaseModule):
     def update(self):
         if not self.optimizer_initialized:
             raise MXNetError("call init_optimizer before update")
+        entries = []
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
-            self._updater(i, grad, self._exec.arg_dict[name])
+            entries.append((i, grad, self._exec.arg_dict[name]))
+        from ..optimizer.fused import FusedUpdater
+
+        apply_batch = (self._updater.apply
+                       if isinstance(self._updater, FusedUpdater) else None)
+        if apply_batch is not None:
+            # fused path: every dense param updates in one jitted call
+            # (executor-owned buffers stay undonated — rebind aliases them)
+            info = apply_batch(entries)
+            from .. import telemetry
+
+            if telemetry.enabled() and info.get("n_fused"):
+                telemetry.record_fused_update(
+                    n_params=info["n_params"], n_buckets=0,
+                    nbytes=info["nbytes"],
+                    n_jitted_calls=info["n_jitted_calls"])
+        else:
+            for i, grad, weight in entries:
+                self._updater(i, grad, weight)
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

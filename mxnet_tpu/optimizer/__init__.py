@@ -1,4 +1,5 @@
 """Optimizer package (reference: python/mxnet/optimizer/)."""
 from .optimizer import *
 from .optimizer import Optimizer, Updater, get_updater, register, create
+from .fused import FusedUpdater, fused_enabled
 from . import lr_scheduler

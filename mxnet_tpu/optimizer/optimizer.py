@@ -647,7 +647,7 @@ class Updater:
         self.states: Dict[Any, Any] = {}
         self.states_synced: Dict[Any, bool] = {}
 
-    def __call__(self, index, grad, weight):
+    def _ensure_state(self, index, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
@@ -661,8 +661,11 @@ class Updater:
                 index, weight)
             _numpy_to_states(self.states[index], snapshot)
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
+        state = self._ensure_state(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, state)
 
     def get_states(self, dump_optimizer=False):
         import pickle
@@ -735,4 +738,10 @@ def _numpy_to_states(s, snp):
 
 
 def get_updater(optimizer: Optimizer) -> Updater:
+    """The kvstore/Trainer updater for `optimizer`: the fused batch updater
+    unless MX_FUSED_UPDATE=0 pins the per-param path (docs/PERFORMANCE.md)."""
+    from .fused import FusedUpdater, fused_enabled
+
+    if fused_enabled():
+        return FusedUpdater(optimizer)
     return Updater(optimizer)

@@ -27,7 +27,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["init_from_env", "is_initialized", "allreduce_sum",
-           "process_index", "process_count"]
+           "process_index", "process_count", "bucket_cap_bytes",
+           "flatten_bucket", "unflatten_bucket"]
 
 _initialized = False
 
@@ -180,6 +181,69 @@ def process_count() -> int:
         return jax.process_count()
     except Exception:
         return 1
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing (docs/PERFORMANCE.md)
+#
+# Coalescing many small per-param gradients into size-capped flat buckets
+# is what turns an O(n_params) stream of sub-megabyte collectives into
+# O(total_bytes / cap) wire-efficient ones.  The flatten/unflatten pair
+# lives here because BOTH reduction planes ride it: the intra-host device
+# reduce (kvstore._reduce over ICI) and this module's cross-host DCN
+# allreduce.  Each is one jitted dispatch per bucket; jax's signature
+# cache makes repeat steps free.
+# ---------------------------------------------------------------------------
+_BUCKET_MB_DEFAULT = 32.0
+
+
+def bucket_cap_bytes() -> int:
+    """Gradient-allreduce bucket cap in bytes (MX_ALLREDUCE_BUCKET_MB,
+    default 32 MB).  0 (or any non-positive/garbled value) disables
+    bucketing entirely — the per-param pushpull kill switch."""
+    raw = os.environ.get("MX_ALLREDUCE_BUCKET_MB")
+    try:
+        mb = float(raw) if raw is not None else _BUCKET_MB_DEFAULT
+    except (TypeError, ValueError):
+        return 0
+    return int(mb * (1 << 20)) if mb > 0 else 0
+
+
+_flatten_jit = None
+_unflatten_cache: Dict[Tuple, object] = {}
+
+
+def flatten_bucket(arrs):
+    """Concatenate same-dtype jax arrays into one flat buffer — a single
+    jitted dispatch regardless of how many gradients the bucket holds."""
+    global _flatten_jit
+    if _flatten_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _flatten_jit = jax.jit(
+            lambda *xs: jnp.concatenate([x.reshape(-1) for x in xs]))
+    return _flatten_jit(*arrs)
+
+
+def unflatten_bucket(flat, shapes):
+    """Split a reduced flat bucket back into the original shapes (one
+    jitted dispatch; executables cached per bucket layout)."""
+    shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+    fn = _unflatten_cache.get(shapes)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        offsets = list(np.cumsum(sizes)[:-1])
+
+        def split(buf):
+            parts = jnp.split(buf, offsets) if offsets else [buf]
+            return tuple(p.reshape(s) for p, s in zip(parts, shapes))
+
+        fn = _unflatten_cache[shapes] = jax.jit(split)
+    return fn(flat)
 
 
 # ---------------------------------------------------------------------------

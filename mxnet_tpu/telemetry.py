@@ -59,9 +59,9 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 __all__ = ["enabled", "enable", "disable", "record", "record_step",
-           "record_collective", "heartbeat", "note_signature", "summary",
-           "flight_tail", "flush", "reset", "rank", "event_path",
-           "heartbeat_path", "RING_SIZE"]
+           "record_collective", "record_fused_update", "heartbeat",
+           "note_signature", "summary", "flight_tail", "flush", "reset",
+           "rank", "event_path", "heartbeat_path", "RING_SIZE"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -124,6 +124,8 @@ class _State:
         self.steps: Dict[str, Dict[str, float]] = {}
         self.coll = {"count": 0, "bytes": 0, "total_ms": 0.0,
                      "compile_ms": 0.0}
+        self.fused = {"count": 0, "n_params": 0, "n_buckets": 0,
+                      "bytes": 0, "jitted_calls": 0}
         self.ckpt = {"saves": 0, "save_ms": 0.0, "save_bytes": 0,
                      "loads": 0, "load_ms": 0.0, "fallbacks": 0}
         # executor -> {"sigs": set, "traces": int, "warned_at": int,
@@ -298,6 +300,25 @@ def record_collective(op: str, nbytes: int, wall_s: float,
             _state.coll["total_ms"] += wall_s * 1e3
     record("collective", op=op, nbytes=int(nbytes),
            wall_ms=round(wall_s * 1e3, 3), traced=bool(traced), **fields)
+
+
+def record_fused_update(n_params: int, n_buckets: int, nbytes: int,
+                        n_jitted_calls: int, **fields) -> None:
+    """One fused optimizer step (docs/PERFORMANCE.md): how many params
+    updated, through how many gradient buckets and jitted update calls —
+    the before/after evidence that the O(n_params) dispatch storm
+    collapsed to O(1).  Aggregated under ``summary()['fused_update']``."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        f = _state.fused
+        f["count"] += 1
+        f["n_params"] += int(n_params)
+        f["n_buckets"] += int(n_buckets)
+        f["bytes"] += int(nbytes)
+        f["jitted_calls"] += int(n_jitted_calls)
+    record("fused_update", n_params=int(n_params), n_buckets=int(n_buckets),
+           nbytes=int(nbytes), n_jitted_calls=int(n_jitted_calls), **fields)
 
 
 def record_checkpoint(event: str, step: int, wall_s: float = 0.0,
@@ -485,6 +506,7 @@ def summary() -> dict:
             },
             "checkpoints": {k: (round(v, 3) if isinstance(v, float) else v)
                             for k, v in _state.ckpt.items()},
+            "fused_update": dict(_state.fused),
             "retraces": retraces,
             "restart_count": int(
                 os.environ.get("MX_RESTART_COUNT", "0") or 0),

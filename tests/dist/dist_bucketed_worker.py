@@ -1,0 +1,81 @@
+"""Worker body for the 2-process bucketed-allreduce parity test
+(docs/PERFORMANCE.md): with a deliberately tiny MX_ALLREDUCE_BUCKET_MB the
+gradient pushes must coalesce into MULTIPLE flat buckets that cross the
+process boundary as whole-bucket collectives, while every pulled value
+still equals the analytic per-key global sum.  Run via:
+
+    python tools/launch.py -n 2 --force-cpu python tests/dist/dist_bucketed_worker.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# 80-byte cap: both the 4 analytic keys below and the toy net's 4 params
+# (64+16+16+4 bytes) must split into >=2 buckets
+os.environ["MX_ALLREDUCE_BUCKET_MB"] = str(80 / (1 << 20))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    keys = [0, 1, 2, 3]
+    shapes = [(4, 3), (7,), (2, 2, 2), (5, 2)]
+    rng = np.random.RandomState(0)  # SAME base values on all ranks
+    base = {k: rng.randn(*s).astype(np.float32) for k, s in zip(keys, shapes)}
+
+    # --- bucketed aggregation parity: pull == sum over ranks -------------
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.zeros(s))
+    n_buckets = kv.push_bucketed(
+        keys, [nd.array(base[k] * (rank + 1)) for k in keys])
+    assert n_buckets >= 2, f"tiny cap must split buckets, got {n_buckets}"
+    scale = sum(r + 1 for r in range(n))  # 3 for n=2
+    for k, s in zip(keys, shapes):
+        out = nd.zeros(s)
+        kv.pull(k, out)
+        np.testing.assert_allclose(out.asnumpy(), base[k] * scale, rtol=1e-5)
+
+    # --- end to end: bucketed + fused trainer keeps replicas identical ---
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = X @ np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    lo, hi = rank * (32 // n), (rank + 1) * (32 // n)
+    mx.random.seed(rank)  # init broadcast must align the replicas
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Normal(0.5))
+    kv2 = mx.kv.create("dist_sync")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=kv2)
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for _epoch in range(60):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X[lo:hi])), nd.array(Y[lo:hi]))
+        loss.backward()
+        trainer.step(hi - lo)
+        if first is None:
+            first = float(loss.mean().asnumpy())
+    assert trainer._last_n_buckets >= 2, trainer._last_n_buckets
+    final = float(loss.mean().asnumpy())
+    assert final < first * 0.1, f"rank {rank}: loss {first} -> {final}"
+    for p in net.collect_params().values():
+        w = p.data().asnumpy()
+        summed = kv2._global_sum(p.data())
+        np.testing.assert_allclose(
+            summed.asnumpy(), w * n, rtol=1e-5,
+            err_msg=f"param {p.name} diverged across workers")
+    print(f"worker {rank}/{n}: bucketed allreduce OK buckets={n_buckets} "
+          f"loss={final:.5f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

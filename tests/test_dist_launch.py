@@ -35,6 +35,17 @@ def test_dist_sync_training_two_workers():
     assert res.stdout.count("dist train OK") == 2, res.stdout
 
 
+@pytest.mark.slow
+def test_dist_bucketed_allreduce_two_workers():
+    """Bucketed-allreduce parity across a real 2-rank gang: a tiny bucket
+    cap forces multi-bucket coalescing, pulls must equal the analytic
+    global sums, and a fused+bucketed Trainer must keep replicas
+    bit-identical (docs/PERFORMANCE.md)."""
+    res = _launch(2, "tests/dist/dist_bucketed_worker.py", timeout=300)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("bucketed allreduce OK") == 2, res.stdout
+
+
 def test_dist_sync_kvstore_three_workers():
     """n=3 exercises non-power-of-two reduction and rank indexing that n=2
     cannot (reference CI: tools/launch.py -n 3 -s 3 --launcher local
