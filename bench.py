@@ -320,6 +320,21 @@ def main():
             result["trainer_step_overhead"] = ovh
             print(json.dumps(result), flush=True)
 
+    # pipeline_overlap: async step pipeline (non-blocking dispatch + device
+    # prefetch + deferred readback) vs synchronous per-step forcing, on a
+    # prep/transfer-heavy toy net.  Host-pipelining-bound by construction,
+    # so it measures on CPU; rides the same merged-record contract.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_PIPELINE", "1") != "0"
+            and "error" not in result):
+        pipe = _run_child("cpu", float(os.environ.get(
+            "BENCH_PIPELINE_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "pipeline_overlap"})
+        if pipe is not None:
+            pipe.pop("probe_history", None)
+            result["pipeline_overlap"] = pipe
+            print(json.dumps(result), flush=True)
+
 
 # ---------------------------------------------------------------------------
 # measurement children
@@ -625,6 +640,106 @@ def bench_trainer_overhead(platform):
     }))
 
 
+def bench_pipeline_overlap(platform):
+    """Secondary metric: the async step pipeline win — steps/sec with
+    MX_ASYNC_INFLIGHT=2 + DevicePrefetchIter (non-blocking dispatch,
+    background device staging, deferred loss readback) vs
+    MX_ASYNC_INFLIGHT=0 (every step forced at dispatch, today's old
+    behavior), best-of-N trials, on a transfer/prep-heavy toy model where
+    host-side batch prep + H2D is comparable to device compute — the
+    regime the pipeline exists for.  Values well above 1 are the point
+    (docs/PERFORMANCE.md §Async pipeline).  The telemetry block_wait
+    rollup per mode rides along as the host-blocking evidence."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    B = int(os.environ.get("BENCH_PIPELINE_BATCH", 256))
+    D = int(os.environ.get("BENCH_PIPELINE_DIM", 8192))
+    steps = int(os.environ.get("BENCH_PIPELINE_STEPS", 24))
+    trials = int(os.environ.get("BENCH_PIPELINE_TRIALS", 3))
+
+    base = np.random.RandomState(0).rand(steps * B, D).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 10, steps * B).astype(np.float32)
+
+    class AugIter(mx.io.DataIter):
+        """Per-batch host 'augmentation' (normalize + nonlinearity):
+        genuine numpy work the pipeline can overlap with device compute."""
+
+        def __init__(self):
+            super().__init__(batch_size=B)
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            from mxnet_tpu import nd
+
+            if self.i >= steps:
+                raise StopIteration
+            x = base[self.i * B:(self.i + 1) * B]
+            x = np.tanh((x - x.mean(axis=1, keepdims=True))
+                        / (x.std(axis=1, keepdims=True) + 1e-6))
+            x = (x + np.tanh(1.5 * x - 0.25)).astype(np.float32)
+            lab = ys[self.i * B:(self.i + 1) * B]
+            self.i += 1
+            return mx.io.DataBatch([nd.array(x)], [nd.array(lab)])
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    step = DataParallelStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mesh=local_mesh(devices=[ctx.jax_device]), optimizer="sgd",
+        optimizer_params={"learning_rate": 1e-3})
+
+    import tempfile
+
+    tele_dir = tempfile.mkdtemp(prefix="bench_pipeline_tele_")
+
+    def run_mode(inflight, prefetch):
+        os.environ["MX_ASYNC_INFLIGHT"] = str(inflight)
+        telemetry.reset()
+        telemetry.enable(tele_dir)  # block_wait only aggregates when on
+        best = float("inf")
+        for _ in range(1 + trials):  # first pass warms the compile cache
+            it = AugIter()
+            it = mx.io.DevicePrefetchIter(it, step) if prefetch else it
+            t0 = time.perf_counter()
+            loss = None
+            for b in it:
+                loss = step.step(b.data[0], b.label[0])
+                if inflight == 0:
+                    float(loss)  # the old per-step host round-trip
+            step.drain()
+            float(loss)
+            best = min(best, time.perf_counter() - t0)
+        blocked = sum(row.get("block_wait_ms", 0.0)
+                      for row in telemetry.summary()["steps"].values())
+        return steps / best, round(blocked, 1)
+
+    sync_sps, sync_block = run_mode(0, prefetch=False)
+    async_sps, async_block = run_mode(2, prefetch=True)
+    print(json.dumps({
+        "metric": "pipeline_overlap",
+        "value": round(async_sps / sync_sps, 3) if sync_sps else 0.0,
+        "unit": "x_async_vs_sync",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "async_steps_per_sec": round(async_sps, 2),
+        "sync_steps_per_sec": round(sync_sps, 2),
+        "sync_block_wait_ms": sync_block,
+        "async_block_wait_ms": async_block,
+        "batch": B, "dim": D, "steps": steps,
+    }))
+
+
 def child_main(platform):
     model = os.environ.get("BENCH_MODEL", "resnet")
     if model == "bert":
@@ -633,6 +748,8 @@ def child_main(platform):
         bench_transformer(platform)
     elif model == "trainer_overhead":
         bench_trainer_overhead(platform)
+    elif model == "pipeline_overlap":
+        bench_pipeline_overlap(platform)
     else:
         bench_resnet(platform)
 

@@ -142,12 +142,16 @@ def main():
             loss = forward()
         loss.backward()
         trainer.step(B)
-        last = float(loss.asnumpy().mean())
-        if first is None:
-            first = last
+        # force the loss to host ONLY at display cadence: a per-step
+        # asnumpy() blocks the dispatch pipeline on every iteration
+        if i % 5 == 0 or i == args.steps - 1:
+            last = float(loss.asnumpy().mean())
+            if first is None:
+                first = last
         if i % 5 == 0:
             print(f"step {i}: loss={last:.4f}  "
                   f"{(i + 1) * B / (time.perf_counter() - t0):.1f} img/s")
+    trainer.drain()
     print(f"{args.model}: loss {first:.4f} -> {last:.4f} "
           f"({args.steps} steps)")
     assert np.isfinite(last)

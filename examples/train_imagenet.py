@@ -62,6 +62,10 @@ def main():
             path_imgrec=args.rec, batch_size=args.batch_size,
             data_shape=shape, shuffle=True, rand_crop=True,
             rand_mirror=True)
+        # device-side prefetch: decode/augment AND the H2D transfer of the
+        # next batch run in a background thread while the current fused
+        # step computes (the step skips its own transfer)
+        it = mx.io.DevicePrefetchIter(it, step)
 
         def batches():
             while True:
@@ -87,13 +91,16 @@ def main():
     gen = batches()
     t0 = time.perf_counter()
     for i, (data, label) in zip(range(args.steps), gen):
+        # step() returns a LAZY AsyncLoss: dispatch never blocks, and the
+        # loss is only read back at the logging interval below
         loss = step.step(data, label)
         if i % 10 == 0:
-            v = float(np.asarray(loss))
+            v = float(loss)
             dt = time.perf_counter() - t0
             seen = (i + 1) * args.batch_size
             print(f"step {i}: loss={v:.4f}  {seen / dt:.1f} img/s")
-    v = float(np.asarray(loss))
+    step.drain()  # land (and error-check) every in-flight step
+    v = float(loss)
     print(f"final loss {v:.4f}")
     assert np.isfinite(v)
 

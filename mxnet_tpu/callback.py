@@ -42,6 +42,11 @@ class Speedometer:
     monotonic (NTP slews, manual clock steps), and a backwards step across
     the measurement window produced negative or absurd samples/sec.  When
     the telemetry recorder is active, each report is also recorded there.
+
+    Lazy-loss aware: when ``param.loss`` carries an async handle
+    (``parallel.AsyncLoss`` / an unforced array), it is forced to a host
+    scalar ONLY here, at display cadence — a training loop feeding the
+    Speedometer never pays a per-step device round-trip for logging.
     """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
@@ -64,6 +69,14 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = (self.frequent * self.batch_size
                          / (time.perf_counter() - self.tic))
+                # the ONLY place the (possibly async) loss is forced
+                loss = getattr(param, "loss", None)
+                loss_txt = ""
+                if loss is not None:
+                    import numpy as _np
+
+                    loss_txt = "\tloss=%f" % float(
+                        _np.asarray(loss).mean())
                 if telemetry.enabled():
                     telemetry.record("speedometer", epoch=param.epoch,
                                      batch=count,
@@ -74,12 +87,12 @@ class Speedometer:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
                     msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
+                    logging.info(msg + loss_txt, param.epoch, count, speed,
                                  *sum(name_value, ()))
                 else:
                     logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                        + loss_txt, param.epoch, count, speed)
                 self.tic = time.perf_counter()
         else:
             self.init = True

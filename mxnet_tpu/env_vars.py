@@ -117,6 +117,15 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "per-param pushpulls coalesce into flat buckets this large so "
         "one collective moves many grads; 0 disables bucketing "
         "(parallel/dist.py bucket_cap_bytes, kvstore.py push_bucketed)"),
+    # async step pipeline (docs/PERFORMANCE.md §Async pipeline)
+    "MX_ASYNC_INFLIGHT": (
+        "honored", "bounded in-flight dispatch window: how many "
+        "dispatched-but-unforced steps may be pending before dispatch "
+        "blocks on the oldest (default 2; 0 = synchronous, every step "
+        "forced at dispatch).  Read per step call by "
+        "parallel/async_loss.py; honored by DataParallelStep.step (lazy "
+        "AsyncLoss), gluon Trainer.step and module.Module.update (step "
+        "fences)"),
     # runtime telemetry (docs/OBSERVABILITY.md)
     "MX_TELEMETRY_DIR": (
         "honored", "enables the telemetry recorder: one rank-<R>.jsonl "

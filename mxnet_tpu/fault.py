@@ -232,6 +232,20 @@ def install_preemption_handler(ckpt, params, trainer=None,
 
     Returns the installed handler (mainly for tests)."""
     def _handler(signum, frame):
+        import sys as _sys
+
+        # drain the async dispatch windows first: a pending step must land
+        # in the device buffers before the sync snapshot reads them, and a
+        # deferred failure must not masquerade as a checkpoint error.
+        # sys.modules lookup (not import): if the async layer was never
+        # imported, nothing can be pending — and a signal handler must not
+        # run fresh imports.
+        _async = _sys.modules.get("mxnet_tpu.parallel.async_loss")
+        if _async is not None:
+            try:
+                _async.drain_all()
+            except BaseException:  # noqa: BLE001 — dying anyway
+                pass
         step = None
         try:
             step = ckpt.save_now(params, trainer=trainer)

@@ -159,7 +159,8 @@ class DataLoader:
                  batch_sampler=None, batchify_fn: Optional[Callable] = None,
                  num_workers: int = 0, pin_memory: bool = False,
                  pin_device_id: int = 0, prefetch: Optional[int] = None,
-                 thread_pool: bool = False, timeout: int = 120):
+                 thread_pool: bool = False, timeout: int = 120,
+                 prefetch_to=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -185,6 +186,11 @@ class DataLoader:
         self._timeout = timeout
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        # device-side prefetch hook (docs/PERFORMANCE.md §Async pipeline):
+        # a DataParallelStep here stages every yielded batch onto the
+        # step's input shardings in a background thread, so step() skips
+        # its own H2D transfer
+        self._prefetch_to = prefetch_to
         self._pool = None  # lazy persistent process pool
 
     def _load(self, indices) -> object:
@@ -201,6 +207,13 @@ class DataLoader:
         return self._pool
 
     def __iter__(self):
+        if self._prefetch_to is None:
+            return self._iter_batches()
+        from ...io.io import stage_batches
+
+        return stage_batches(self._iter_batches(), self._prefetch_to)
+
+    def _iter_batches(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._load(batch)

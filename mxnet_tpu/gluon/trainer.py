@@ -53,6 +53,7 @@ class Trainer:
         self._params_to_init: List[Parameter] = []
         self._step_count = 0
         self._last_n_buckets = 0
+        self._inflight = None  # lazy InflightRing (MX_ASYNC_INFLIGHT > 0)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -128,12 +129,27 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
-        """Rescale grads by 1/batch_size, aggregate across devices, update."""
+        """Rescale grads by 1/batch_size, aggregate across devices, update.
+
+        Dispatch is non-blocking (jax queues the reduce/update programs);
+        a bounded in-flight window (``MX_ASYNC_INFLIGHT``, the same knob
+        as the fused ``DataParallelStep``) keeps the host from racing more
+        than N un-synced steps ahead of the device: past the cap the step
+        blocks on the OLDEST pending update's buffers first.  ``=0`` adds
+        no fences (the pre-window behavior)."""
         import time as _time
 
         from .. import telemetry
+        from ..parallel.async_loss import (InflightRing, StepFence,
+                                           inflight_limit)
 
         t0 = _time.perf_counter()
+        limit = inflight_limit()
+        block_wait_s = 0.0
+        if limit > 0:
+            if self._inflight is None:
+                self._inflight = InflightRing("Trainer")
+            block_wait_s = self._inflight.make_room(limit)
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -142,13 +158,25 @@ class Trainer:
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         self._step_count += 1
+        depth = 0
+        if limit > 0:
+            fence = StepFence(
+                [arr._data for p in self._params if p.grad_req != "null"
+                 and p._data is not None for arr in p.list_data()],
+                step=self._step_count, executor="Trainer",
+                ring=self._inflight)
+            depth = self._inflight.admit(fence)
         if telemetry.enabled():
             # first step pays kvstore init + jit compiles of the
             # reduce/update programs — keep it out of the exec aggregates
+            # (make_room's internal wait() already recorded the blocked
+            # time in the rollup; the per-event field below is metadata)
             telemetry.record_step("Trainer", step=self._step_count,
                                   wall_s=_time.perf_counter() - t0,
                                   samples=int(batch_size),
-                                  traced=self._step_count == 1)
+                                  traced=self._step_count == 1,
+                                  inflight_depth=depth,
+                                  block_wait_ms=round(block_wait_s * 1e3, 3))
             info = {"n_params": 0, "n_fused": 0, "nbytes": 0,
                     "n_jitted_calls": 0}
             for upd in self._fused_updaters():
@@ -169,6 +197,12 @@ class Trainer:
                     n_jitted_calls=info["n_jitted_calls"],
                     step=self._step_count)
             telemetry.heartbeat(self._step_count)
+
+    def drain(self) -> None:
+        """Block until every in-flight update has landed in the parameter
+        buffers (epoch end / pre-checkpoint sync)."""
+        if self._inflight is not None:
+            self._inflight.drain()
 
     def _fused_updaters(self):
         """Every FusedUpdater this trainer's step can route through — its

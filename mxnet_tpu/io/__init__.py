@@ -6,5 +6,6 @@ mxnet_tpu.io.image_iter once the native extension is built; NDArrayIter and
 CSVIter are pure Python/jax.
 """
 from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter,
-                 CSVIter, LibSVMIter, PrefetchingIter)
+                 CSVIter, LibSVMIter, PrefetchingIter, DevicePrefetchIter,
+                 stage_batches)
 from .image_iter import ImageRecordIter

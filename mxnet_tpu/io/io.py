@@ -9,7 +9,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "CSVIter", "LibSVMIter", "PrefetchingIter"]
+           "CSVIter", "LibSVMIter", "PrefetchingIter", "DevicePrefetchIter",
+           "stage_batches"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -377,7 +378,146 @@ class LibSVMIter(DataIter):
             return False
 
 
-class PrefetchingIter(DataIter):
+class _ThreadedIter(DataIter):
+    """Shared background-production discipline for prefetching iterators
+    (reference: io.py threadediter).  Guarantees the wrappers ride on:
+
+    * a worker failure propagates to the consumer EXACTLY ONCE with the
+      worker's original traceback (subsequent ``next()`` raise
+      StopIteration until ``reset()``);
+    * the worker catches BaseException — a dying worker always leaves a
+      message in the queue, so the consumer can never block forever on a
+      silently dead thread (the old ``except Exception`` swallowed e.g.
+      KeyboardInterrupt and hung the consumer);
+    * ``reset()`` restarts cleanly from ANY state — mid-epoch, after
+      exhaustion, after a worker error — via a generation counter: the
+      old worker is retired (it checks the generation around every
+      blocking queue operation), joined, and only then is the wrapped
+      iterator reset for the fresh worker.
+    """
+
+    _QUEUE_DEPTH = 2
+
+    def __init__(self, inner, batch_size=0):
+        super().__init__(batch_size)
+        self._iter = inner
+        self._gen = 0
+        self._done = False
+        import queue
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._thread = None
+        self._start()
+
+    # -- hooks -------------------------------------------------------------
+    def _produce(self):
+        """Produce the next item (worker thread); raise StopIteration at
+        epoch end."""
+        raise NotImplementedError
+
+    def _on_epoch_end(self):
+        """Consumer-side hook when the epoch's 'done' marker is consumed."""
+
+    # -- machinery ---------------------------------------------------------
+    def _start(self):
+        import threading
+
+        gen, q = self._gen, self._queue
+
+        def _put(kind, payload):
+            # bounded put that never deadlocks against a consumer that
+            # already reset(): a stale-generation worker just drops out
+            import queue as _q
+
+            while gen == self._gen:
+                try:
+                    q.put((gen, kind, payload), timeout=0.1)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+
+        def worker():
+            while gen == self._gen:
+                try:
+                    item = self._produce()
+                except StopIteration:
+                    _put("done", None)
+                    return
+                except BaseException as exc:  # noqa: BLE001 — see class doc
+                    _put("error", exc)
+                    return
+                if not _put("batch", item):
+                    return
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._gen += 1  # retire the current worker at its next gen check
+        thread = self._thread
+        while thread is not None and thread.is_alive():
+            try:  # unblock a worker parked on a full queue
+                self._queue.get(timeout=0.05)
+            except Exception:
+                pass
+        if thread is not None:
+            thread.join()
+        # only after the old worker is gone may the wrapped iterator be
+        # touched — two workers interleaving .next() on one iter would
+        # shuffle (or double-consume) batches
+        self._iter.reset()
+        self._done = False
+        import queue
+
+        self._queue = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._start()
+
+    def next(self):
+        import queue as _q
+
+        if self._done:
+            raise StopIteration  # repeatable after exhaustion/error
+        while True:
+            try:
+                gen, kind, payload = self._queue.get(timeout=0.1)
+            except _q.Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    # belt and braces: a worker can no longer die without
+                    # queueing a marker, but never hang the consumer if
+                    # one somehow does
+                    self._done = True
+                    raise MXNetError(
+                        "prefetch worker died without producing a result")
+                continue
+            if gen != self._gen:
+                continue  # stale item from a retired worker
+            if kind == "done":
+                self._done = True
+                self._on_epoch_end()
+                raise StopIteration
+            if kind == "error":
+                self._done = True  # exactly once; then StopIteration
+                raise payload  # original worker traceback rides along
+            return payload
+
+    def iter_next(self):
+        try:
+            self.current_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+
+class PrefetchingIter(_ThreadedIter):
     """Background-thread prefetch wrapper (reference: io.py
     PrefetchingIter over threadediter) — overlaps host-side batch prep
     with device compute, the python analog of the C++ PrefetcherIter.
@@ -393,71 +533,13 @@ class PrefetchingIter(DataIter):
         if len(iters) != 1:
             raise MXNetError("PrefetchingIter here wraps exactly one iter; "
                              "compose multiple with a zip-style wrapper")
-        self._iter = iters[0]
-        super().__init__(getattr(self._iter, "batch_size", 0))
         self._rename_data = (rename_data[0] if rename_data else None)
         self._rename_label = (rename_label[0] if rename_label else None)
-        import queue
+        super().__init__(iters[0],
+                         batch_size=getattr(iters[0], "batch_size", 0))
 
-        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
-        self._stop = False
-        self._done = False
-        self._thread = None
-        self._start()
-
-    def _start(self):
-        import threading
-
-        def worker():
-            while not self._stop:
-                try:
-                    batch = self._iter.next()
-                except StopIteration:
-                    self._queue.put(("done", None))
-                    return
-                except Exception as exc:  # propagate to the consumer
-                    self._queue.put(("error", exc))
-                    return
-                self._queue.put(("batch", batch))
-
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
-
-    def reset(self):
-        # drain: let the worker finish, clear the queue, restart
-        self._stop = True
-        while self._thread.is_alive():
-            try:
-                self._queue.get(timeout=0.1)
-            except Exception:
-                pass
-        self._thread.join()
-        self._iter.reset()
-        self._stop = False
-        self._done = False
-        import queue
-
-        self._queue = queue.Queue(maxsize=2)
-        self._start()
-
-    def next(self):
-        if self._done:
-            raise StopIteration  # repeatable after exhaustion
-        kind, payload = self._queue.get()
-        if kind == "done":
-            self._done = True
-            raise StopIteration
-        if kind == "error":
-            self._done = True
-            raise payload
-        return payload
-
-    def iter_next(self):
-        try:
-            self.current_batch = self.next()
-            return True
-        except StopIteration:
-            return False
+    def _produce(self):
+        return self._iter.next()
 
     def _renamed(self, descs, mapping):
         if not mapping:
@@ -472,3 +554,113 @@ class PrefetchingIter(DataIter):
     @property
     def provide_label(self):
         return self._renamed(self._iter.provide_label, self._rename_label)
+
+
+class DevicePrefetchIter(_ThreadedIter):
+    """Device-side input prefetch: wraps any DataIter and stages the NEXT
+    batch onto a ``DataParallelStep``'s input shardings (via its
+    ``stage()``, i.e. ``_global_put``) from a background thread while the
+    current step computes — so the H2D transfer overlaps device compute
+    instead of serializing in ``step()``.  The step recognizes the
+    pre-placed inputs by their sharding and skips its own transfer
+    (telemetry reports the staged bytes as ``h2d_overlapped``).
+
+    Epoch end drains the step's in-flight window: by the time
+    StopIteration reaches the training loop every dispatched step has
+    landed (and any deferred failure has surfaced).
+
+    Only the FIRST label array is staged (the fused step consumes one
+    label); extra label arrays pass through untouched.
+    """
+
+    def __init__(self, data_iter, step, depth=1):
+        self._step = step
+        self._QUEUE_DEPTH = max(1, int(depth))
+        super().__init__(data_iter,
+                         batch_size=getattr(data_iter, "batch_size", 0))
+
+    def _produce(self):
+        batch = self._iter.next()
+        data = list(batch.data or [])
+        label = list(batch.label or [])
+        staged_data, staged_label = self._step.stage(
+            tuple(data), label[0] if label else None)
+        return DataBatch(list(staged_data),
+                         ([staged_label] + label[1:]) if label else None,
+                         pad=batch.pad, index=batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _on_epoch_end(self):
+        self._step.drain()
+
+
+def stage_batches(iterable, step, depth=1):
+    """Generator wrapper giving any (data, ..., label)-tuple iterable —
+    e.g. a ``gluon.data.DataLoader`` — the same background device staging
+    as :class:`DevicePrefetchIter`: each batch's arrays are pre-placed
+    onto ``step``'s input shardings in a worker thread while the previous
+    step computes.  Batches that are a single array stage as data only;
+    sequences stage all-but-last as data and the last element as label.
+    The step's in-flight window is drained when the iterable ends."""
+    import queue as _q
+    import threading
+
+    q: "_q.Queue" = _q.Queue(maxsize=max(1, int(depth)))
+    _END, _ERR = object(), object()
+    retired = threading.Event()
+
+    def _put(item):
+        # bounded put that never deadlocks against a consumer that
+        # abandoned the generator early (same escape as _ThreadedIter's):
+        # a retired worker drops out instead of pinning the staged device
+        # arrays + this thread forever
+        while not retired.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _q.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for batch in iterable:
+                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                    data, lab = tuple(batch[:-1]), batch[-1]
+                    staged, slab = step.stage(data, lab)
+                    out = list(staged) + [slab]
+                    item = tuple(out) if isinstance(batch, tuple) else out
+                else:
+                    one = batch[0] if isinstance(batch, (list, tuple)) \
+                        else batch
+                    staged, _ = step.stage(one, None)
+                    item = ([staged[0]] if isinstance(batch, list) else
+                            (staged if isinstance(batch, tuple)
+                             else staged[0]))
+                if not _put(item):
+                    return
+        except BaseException as exc:  # noqa: BLE001 — consumer re-raises
+            _put((_ERR, exc))
+            return
+        _put((_END, None))
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            # control markers compare by IDENTITY: a real 2-tuple batch
+            # holds NDArrays whose == is elementwise and must never be
+            # invoked here
+            if type(item) is tuple and len(item) == 2 and \
+                    (item[0] is _END or item[0] is _ERR):
+                if item[0] is _ERR:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        # runs on normal end, on the error re-raise, AND on generator
+        # close/abandonment: retire the worker, then land every in-flight
+        # step so nothing is left pending behind the caller's back
+        retired.set()
+        step.drain()

@@ -10,8 +10,14 @@ from .base import MXNetError
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
            "load_params"]
 
+# `loss` (default None) may carry a LAZY loss handle (parallel.AsyncLoss
+# or an unforced NDArray): callbacks must only force it at their display
+# cadence (Speedometer does), never every batch — forcing is the host
+# round-trip the async step pipeline exists to avoid.
 BatchEndParam = namedtuple("BatchEndParams",
-                           ["epoch", "nbatch", "eval_metric", "locals"])
+                           ["epoch", "nbatch", "eval_metric", "locals",
+                            "loss"])
+BatchEndParam.__new__.__defaults__ = (None,)
 
 
 def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,

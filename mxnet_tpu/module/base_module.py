@@ -14,8 +14,11 @@ from .. import metric as _metric
 
 __all__ = ["BaseModule", "BatchEndParam"]
 
+# `loss` (default None): optional LAZY loss handle — see model.BatchEndParam
 BatchEndParam = namedtuple("BatchEndParam",
-                           ["epoch", "nbatch", "eval_metric", "locals"])
+                           ["epoch", "nbatch", "eval_metric", "locals",
+                            "loss"])
+BatchEndParam.__new__.__defaults__ = (None,)
 
 
 class BaseModule:
@@ -161,6 +164,11 @@ class BaseModule:
                                           locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(param)
+            drain = getattr(self, "drain", None)
+            if drain is not None:
+                # epoch exhaustion lands every in-flight update (and
+                # surfaces any deferred failure) before params are read
+                drain()
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

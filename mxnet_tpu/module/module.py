@@ -198,6 +198,18 @@ class Module(BaseModule):
     def update(self):
         if not self.optimizer_initialized:
             raise MXNetError("call init_optimizer before update")
+        # bounded async dispatch (docs/PERFORMANCE.md §Async pipeline):
+        # the executor's forward/backward and this update all queue
+        # asynchronously in jax; the window keeps the host at most
+        # MX_ASYNC_INFLIGHT un-synced steps ahead (0 = no fences)
+        from ..parallel.async_loss import (InflightRing, StepFence,
+                                           inflight_limit)
+
+        limit = inflight_limit()
+        if limit > 0:
+            if getattr(self, "_inflight", None) is None:
+                self._inflight = InflightRing("Module")
+            self._inflight.make_room(limit)
         entries = []
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
@@ -222,6 +234,19 @@ class Module(BaseModule):
         else:
             for i, grad, weight in entries:
                 self._updater(i, grad, weight)
+        if limit > 0 and entries:
+            self._inflight.admit(StepFence(
+                [w._data for _i, _g, w in entries],
+                step=getattr(self, "_update_count", 0) + 1,
+                executor="Module", ring=self._inflight))
+            self._update_count = getattr(self, "_update_count", 0) + 1
+
+    def drain(self) -> None:
+        """Block until every in-flight update has landed (pre-checkpoint
+        / end-of-fit sync)."""
+        ring = getattr(self, "_inflight", None)
+        if ring is not None:
+            ring.drain()
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

@@ -1,0 +1,269 @@
+"""Async step pipeline primitives: lazy loss handles and the bounded
+in-flight dispatch window (docs/PERFORMANCE.md §Async pipeline).
+
+The reference's dependency engine makes every ``engine.push`` asynchronous:
+the host thread races ahead preparing the next batch while the device
+computes, and only ``WaitToRead`` blocks.  jax already queues execution
+asynchronously on every backend, so the only thing standing between this
+tree and the same pipeline was the per-step host round-trip the callers
+imposed by forcing each loss to a host scalar immediately.
+
+This module supplies the missing pieces:
+
+  * :class:`AsyncLoss` — the lazy handle ``DataParallelStep.step()``
+    returns instead of a host scalar.  ``float()`` / ``.asnumpy()`` /
+    ``.wait()`` force the readback; until then the host never blocks on
+    the device.
+  * :class:`StepFence` — the same discipline for executors that update
+    buffers in place and have no scalar to hand back (``gluon.Trainer``,
+    ``module.Module``): waiting on the fence syncs that step's updates.
+  * :class:`InflightRing` — the bounded window.  ``MX_ASYNC_INFLIGHT``
+    (default 2) caps how many dispatched-but-unforced steps may be
+    pending; admitting a new step past the cap blocks on the *oldest*
+    pending handle first, so the dispatch queue can never run away from
+    the device.  ``MX_ASYNC_INFLIGHT=0`` restores fully synchronous
+    behavior (every step forced at dispatch).
+  * :func:`drain_all` — force every pending handle in the process; the
+    SIGTERM preemption path (``fault.install_preemption_handler``) calls
+    it so a final sync checkpoint never snapshots ahead of an in-flight
+    step it hasn't observed failing.
+
+Asynchrony changes *when* the host observes results, never what is
+computed: per-step losses and final weights are bitwise identical across
+window sizes (asserted by ``tests/test_async_step.py``).  Exceptions a
+deferred step raises surface at the forcing site, wrapped in an
+``MXNetError`` naming the dispatching step.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError
+
+__all__ = ["AsyncLoss", "StepFence", "InflightRing", "inflight_limit",
+           "drain_all"]
+
+_DEFAULT_INFLIGHT = 2
+
+# every ring in the process, so preemption/checkpoint paths can drain
+# pending work they never saw dispatched (weak: a dropped step object
+# must not be kept alive by the registry)
+_live_rings: "weakref.WeakSet[InflightRing]" = weakref.WeakSet()
+_rings_lock = threading.Lock()
+
+
+def inflight_limit() -> int:
+    """The in-flight window size, re-read from ``MX_ASYNC_INFLIGHT`` on
+    every call so tests/benches can flip modes without rebuilding steps.
+    0 means synchronous (force at dispatch)."""
+    try:
+        return max(0, int(os.environ.get("MX_ASYNC_INFLIGHT",
+                                         _DEFAULT_INFLIGHT)))
+    except (TypeError, ValueError):
+        return _DEFAULT_INFLIGHT
+
+
+class _PendingHandle:
+    """One dispatched-but-unforced step.  Subclasses define `_force()`."""
+
+    def __init__(self, step: int, executor: str,
+                 ring: Optional["InflightRing"] = None):
+        self._step = int(step)
+        self._executor = executor
+        self._ring = ring
+        self._forced = False
+        self._host = None
+        self._exc: Optional[BaseException] = None
+
+    @property
+    def step(self) -> int:
+        """The step counter value at dispatch (names the step in errors)."""
+        return self._step
+
+    @property
+    def forced(self) -> bool:
+        return self._forced
+
+    def _force(self):
+        raise NotImplementedError
+
+    def wait(self):
+        """Force the readback/sync.  Blocks until the device has produced
+        this step's result; re-raises (wrapped) anything the deferred
+        computation failed with, naming the dispatching step.  Idempotent:
+        later calls return the cached host value (or re-raise)."""
+        if self._forced:
+            if self._exc is not None:
+                raise self._exc
+            return self._host
+        t0 = time.perf_counter()
+        try:
+            self._host = self._force()
+            return self._host
+        except Exception as exc:
+            # the failure belongs to the step that DISPATCHED the program,
+            # not to whatever line happened to force it much later
+            self._exc = MXNetError(
+                f"async step {self._step} dispatched by {self._executor} "
+                f"failed at deferred readback: {exc}")
+            raise self._exc from exc
+        finally:
+            self._forced = True
+            if self._ring is not None:
+                self._ring.discard(self)
+            # all host time spent blocked on the device funnels into one
+            # per-executor rollup (summary()['steps'][name]['block_wait_ms'])
+            telemetry.record_block_wait(self._executor,
+                                        time.perf_counter() - t0)
+
+    def __repr__(self):
+        state = "forced" if self._forced else "pending"
+        return (f"<{type(self).__name__} step={self._step} "
+                f"executor={self._executor!r} {state}>")
+
+
+class AsyncLoss(_PendingHandle):
+    """Lazy scalar loss.  ``float()``, ``np.asarray()``, ``.asnumpy()``,
+    ``.asscalar()``, ``.item()`` and ``.wait()`` all force readback."""
+
+    def __init__(self, value, step: int, executor: str,
+                 ring: Optional["InflightRing"] = None, host_fn=None):
+        super().__init__(step, executor, ring)
+        self._value = value
+        self._host_fn = host_fn
+
+    def _force(self):
+        value, self._value = self._value, None  # drop the device ref
+        if self._host_fn is not None:
+            value = self._host_fn(value)
+        return np.asarray(value)
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self.wait())
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        return bool(self.asscalar())
+
+    def __array__(self, dtype=None, *args, **kwargs):
+        out = self.asnumpy()
+        return out if dtype is None else out.astype(dtype)
+
+
+class StepFence(_PendingHandle):
+    """Pending handle over in-place buffer updates (Trainer/Module steps):
+    waiting blocks until every listed device array is ready."""
+
+    def __init__(self, arrays, step: int, executor: str,
+                 ring: Optional["InflightRing"] = None):
+        super().__init__(step, executor, ring)
+        self._arrays = list(arrays)
+
+    def _force(self):
+        import jax
+
+        arrays, self._arrays = self._arrays, []
+        jax.block_until_ready(arrays)
+        return None
+
+
+class InflightRing:
+    """Bounded ring of pending handles for ONE executor.
+
+    ``make_room(limit)`` blocks (oldest-first) until fewer than ``limit``
+    handles are pending — the only place the async pipeline ever waits.
+    ``admit()`` registers a freshly dispatched handle and returns the
+    depth, which telemetry reports as ``inflight_depth`` (the window-bound
+    assertion in tests rides on it never exceeding the limit)."""
+
+    def __init__(self, executor: str):
+        self._executor = executor
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        with _rings_lock:
+            _live_rings.add(self)
+
+    def discard(self, handle) -> None:
+        """Drop a handle the consumer forced out-of-band (float(loss))."""
+        with self._lock:
+            try:
+                self._pending.remove(handle)
+            except ValueError:
+                pass
+
+    def _oldest_over(self, limit: int):
+        with self._lock:
+            while self._pending and self._pending[0].forced:
+                self._pending.popleft()
+            if len(self._pending) < max(1, limit):
+                return None
+            return self._pending[0]
+
+    def make_room(self, limit: int) -> float:
+        """Ensure the window has a free slot; returns seconds spent
+        blocked (0.0 when the ring wasn't full)."""
+        waited = 0.0
+        while True:
+            oldest = self._oldest_over(limit)
+            if oldest is None:
+                return waited
+            t0 = time.perf_counter()
+            oldest.wait()  # discards itself from the ring
+            waited += time.perf_counter() - t0
+
+    def admit(self, handle) -> int:
+        with self._lock:
+            self._pending.append(handle)
+            return len(self._pending)
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._pending if not h.forced)
+
+    def drain(self) -> None:
+        """Force every pending handle, oldest first (epoch end, shutdown,
+        checkpoint sync).  Raises the first deferred failure it hits."""
+        while True:
+            with self._lock:
+                while self._pending and self._pending[0].forced:
+                    self._pending.popleft()
+                if not self._pending:
+                    return
+                oldest = self._pending[0]
+            oldest.wait()
+
+
+def drain_all():
+    """Drain every live ring in the process (preemption/checkpoint paths).
+    Best-effort: deferred failures are collected and returned, not raised —
+    the caller is usually about to snapshot-and-exit and must not die on a
+    step that was doomed anyway."""
+    with _rings_lock:
+        rings = list(_live_rings)
+    errors = []
+    for ring in rings:
+        try:
+            ring.drain()
+        except Exception as exc:  # noqa: BLE001 — survey, don't die
+            errors.append(exc)
+    return errors

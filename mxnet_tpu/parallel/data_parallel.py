@@ -14,6 +14,7 @@ function via the same parameter-substitution trace the CachedOp uses.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -21,6 +22,7 @@ import numpy as np
 
 from .. import telemetry
 from ..base import MXNetError
+from .async_loss import AsyncLoss, InflightRing, inflight_limit
 from .sharding import ShardingRules, replicated, shard_batch
 
 __all__ = ["DataParallelStep", "make_train_step"]
@@ -39,6 +41,17 @@ def _global_put(arr, sharding):
     host = np.asarray(arr)
     return jax.make_array_from_callback(
         host.shape, sharding, lambda idx: host[idx])
+
+
+def _maybe_put(arr, sharding):
+    """(placed_array, was_preplaced): skip the transfer when ``arr`` is
+    already a device array carrying exactly the target sharding — the
+    prefetcher/step handshake.  ``io.DevicePrefetchIter`` stages batches
+    through ``DataParallelStep.stage()`` onto these same shardings from a
+    background thread, and the step must not pay the H2D again."""
+    if getattr(arr, "sharding", None) == sharding:
+        return arr, True
+    return _global_put(arr, sharding), False
 
 
 def _host_scalar(loss):
@@ -263,42 +276,54 @@ class DataParallelStep:
         self._shardings = None
         self._jitted = None
         self._step_count = 0
+        # bounded async dispatch window (MX_ASYNC_INFLIGHT handles pending
+        # at once); the device prefetcher's staging thread and step() may
+        # both trigger first-use state init, hence the lock
+        self._inflight = InflightRing(self._tele_name)
+        self._state_lock = threading.Lock()
 
     def _ensure_state(self, example_inputs):
         """Gather params (resolving deferred init via one eager forward) and
-        shard them per the rules."""
+        shard them per the rules.  Thread-safe: a DevicePrefetchIter's
+        background stage() may race the first step() here."""
         import jax
 
         if self.params is not None:
             return
-        from .. import autograd
-        from ..gluon.parameter import DeferredInitializationError
+        with self._state_lock:
+            if self.params is not None:
+                return
+            from .. import autograd
+            from ..gluon.parameter import DeferredInitializationError
 
-        try:
-            for _, p in self._param_items:
-                p.data()
-        except DeferredInitializationError:
-            with autograd.pause(train_mode=True):
-                self.block(*example_inputs)
-        names = [n for n, _ in self._param_items]
-        shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
-        self._shardings = self.rules.shardings(self.mesh, shapes)
-        self.params = {
-            n: _global_put(p.data()._data, self._shardings[n])
-            for n, p in self._param_items
-        }
-        if self._optimizer == "sgd":
-            self.opt_state = {
-                n: _global_put(np.zeros(shapes[n], np.float32),
-                               self._shardings[n])
-                for n in names
+            try:
+                for _, p in self._param_items:
+                    p.data()
+            except DeferredInitializationError:
+                with autograd.pause(train_mode=True):
+                    self.block(*example_inputs)
+            names = [n for n, _ in self._param_items]
+            shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
+            self._shardings = self.rules.shardings(self.mesh, shapes)
+            params = {
+                n: _global_put(p.data()._data, self._shardings[n])
+                for n, p in self._param_items
             }
-        else:
-            z = {n: _global_put(np.zeros(shapes[n], np.float32),
-                                self._shardings[n]) for n in names}
-            z2 = {n: _global_put(np.zeros(shapes[n], np.float32),
-                                 self._shardings[n]) for n in names}
-            self.opt_state = (z, z2, jax.numpy.zeros((), jax.numpy.int32))
+            if self._optimizer == "sgd":
+                self.opt_state = {
+                    n: _global_put(np.zeros(shapes[n], np.float32),
+                                   self._shardings[n])
+                    for n in names
+                }
+            else:
+                z = {n: _global_put(np.zeros(shapes[n], np.float32),
+                                    self._shardings[n]) for n in names}
+                z2 = {n: _global_put(np.zeros(shapes[n], np.float32),
+                                     self._shardings[n]) for n in names}
+                self.opt_state = (z, z2,
+                                  jax.numpy.zeros((), jax.numpy.int32))
+            # publish params LAST: it is the unlocked fast-path check
+            self.params = params
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -413,8 +438,88 @@ class DataParallelStep:
         )
 
     # ------------------------------------------------------------------
+    def _input_shardings(self, data_arrs, label_arr):
+        """Per-input shardings for one batch -> (data_shardings,
+        label_sharding, sp_active).  Shared by step() and the prefetcher's
+        stage() so both place inputs identically (the handshake contract).
+
+        With an active 'sp' axis, the sequence dim (1) shards over it:
+        true sequence parallelism — GSPMD emits the cross-device
+        collectives for attention over the sharded T axis.  Gated (r3
+        advisor): only when the caller opted in via seq_axis=1, or in auto
+        mode when dim 1 is actually divisible by the sp size — image
+        batches (NCHW: dim 1 = 3 channels) fall back to plain dp*sp batch
+        sharding."""
+        sp_active = (
+            "sp" in self.mesh.axis_names
+            and self.mesh.shape["sp"] > 1
+            and "sp" in self._batch_axes
+            and self._seq_axis != -1
+            and any(np.ndim(a) >= 2 for a in data_arrs)
+        )
+        if sp_active and self._seq_axis is None:
+            sp_active = all(np.shape(a)[1] % self.mesh.shape["sp"] == 0
+                            for a in data_arrs if np.ndim(a) >= 2)
+        if self._seq_axis == 1 and sp_active:
+            # explicit SP opt-in: a non-divisible seq dim is a caller error,
+            # not something to silently decline (the ring scope and the
+            # shard specs must agree on what was sequence-sharded)
+            bad = [np.shape(a) for a in data_arrs
+                   if np.ndim(a) >= 2
+                   and np.shape(a)[1] % self.mesh.shape["sp"] != 0]
+            if bad:
+                raise MXNetError(
+                    f"seq_axis=1: sequence dims of {bad} are not divisible "
+                    f"by sp={self.mesh.shape['sp']}")
+
+        def _shard_one(arr):
+            if (sp_active and np.ndim(arr) >= 2
+                    and np.shape(arr)[1] % self.mesh.shape["sp"] == 0):
+                from .sharding import shard_batch_seq
+
+                return shard_batch_seq(self.mesh, np.ndim(arr))
+            if sp_active:  # rank-1 (or ragged) input under SP: dp only
+                return shard_batch(self.mesh, ("dp",), np.ndim(arr))
+            return shard_batch(self.mesh, self._batch_axes, np.ndim(arr))
+
+        return (tuple(_shard_one(a) for a in data_arrs),
+                _shard_one(label_arr), sp_active)
+
+    def stage(self, data, label):
+        """Pre-place one batch onto this step's input shardings (the
+        device-side prefetch half of the pipeline) -> (data_tuple, label)
+        of device-backed NDArrays.  Called from ``io.DevicePrefetchIter``'s
+        background thread while the current step computes; a later
+        ``step()`` recognizes the placement and skips its own transfer.
+        Values are bit-identical either way — staging only moves WHEN the
+        H2D copy happens."""
+        from ..ndarray import NDArray
+
+        datas = tuple(data) if isinstance(data, (tuple, list)) else (data,)
+        datas = tuple(d if isinstance(d, NDArray)
+                      else NDArray(d, ctx=self._ctx) for d in datas)
+        self._ensure_state(datas)
+        data_arrs = tuple(d._data for d in datas)
+        label_arr = (label._data if isinstance(label, NDArray) else label)
+        data_sh, label_sh, _sp = self._input_shardings(data_arrs, label_arr)
+        staged = tuple(
+            NDArray(_maybe_put(a, s)[0], ctx=self._ctx)
+            for a, s in zip(data_arrs, data_sh))
+        staged_label = (None if label is None else
+                        NDArray(_maybe_put(label_arr, label_sh)[0],
+                                ctx=self._ctx))
+        return staged, staged_label
+
     def step(self, data, label):
-        """One fused training step; returns the (host) scalar loss array.
+        """One fused training step; returns a lazy :class:`AsyncLoss`.
+
+        Dispatch is non-blocking (jax queues the execution): the handle's
+        ``float()`` / ``.asnumpy()`` / ``.wait()`` force the host readback,
+        so compute for step N overlaps host prep for step N+1.  At most
+        ``MX_ASYNC_INFLIGHT`` (default 2) steps may be pending — admitting
+        one more blocks on the oldest first; ``MX_ASYNC_INFLIGHT=0``
+        forces every step at dispatch (the old synchronous behavior, same
+        numbers: asynchrony never changes what is computed).
 
         `data` may be a single NDArray or a tuple/list of NDArrays for
         multi-input blocks (e.g. the seq2seq Transformer's (src, tgt))."""
@@ -450,49 +555,26 @@ class DataParallelStep:
         self._ensure_state(datas)
         if self._jitted is None:
             self._build()
+        # bounded window: block on the OLDEST pending step only when the
+        # ring is full, BEFORE paying this batch's placement — the
+        # remaining in-flight steps keep the device busy meanwhile
+        limit = inflight_limit()
+        block_wait_s = (self._inflight.make_room(limit) if limit > 0 else 0.0)
         data_arrs = tuple(d._data for d in datas)
         label_arr = label._data if isinstance(label, NDArray) else label
-        # with an active 'sp' axis, shard the sequence dim (1) over it:
-        # true sequence parallelism — GSPMD emits the cross-device
-        # collectives for attention over the sharded T axis.
-        # Gated (r3 advisor): only when the caller opted in via seq_axis=1,
-        # or in auto mode when dim 1 is actually divisible by the sp size —
-        # image batches (NCHW: dim 1 = 3 channels) fall back to plain
-        # dp*sp batch sharding, which is what r2 did for any rank.
-        sp_active = (
-            "sp" in self.mesh.axis_names
-            and self.mesh.shape["sp"] > 1
-            and "sp" in self._batch_axes
-            and self._seq_axis != -1
-            and any(np.ndim(a) >= 2 for a in data_arrs)
-        )
-        if sp_active and self._seq_axis is None:
-            sp_active = all(np.shape(a)[1] % self.mesh.shape["sp"] == 0
-                            for a in data_arrs if np.ndim(a) >= 2)
-        if self._seq_axis == 1 and sp_active:
-            # explicit SP opt-in: a non-divisible seq dim is a caller error,
-            # not something to silently decline (the ring scope and the
-            # shard specs must agree on what was sequence-sharded)
-            bad = [np.shape(a) for a in data_arrs
-                   if np.ndim(a) >= 2
-                   and np.shape(a)[1] % self.mesh.shape["sp"] != 0]
-            if bad:
-                raise MXNetError(
-                    f"seq_axis=1: sequence dims of {bad} are not divisible "
-                    f"by sp={self.mesh.shape['sp']}")
-
-        def _shard_one(arr):
-            if (sp_active and np.ndim(arr) >= 2
-                    and np.shape(arr)[1] % self.mesh.shape["sp"] == 0):
-                from .sharding import shard_batch_seq
-
-                return shard_batch_seq(self.mesh, np.ndim(arr))
-            if sp_active:  # rank-1 (or ragged) input under SP: dp only
-                return shard_batch(self.mesh, ("dp",), np.ndim(arr))
-            return shard_batch(self.mesh, self._batch_axes, np.ndim(arr))
-
-        data_arrs = tuple(_global_put(a, _shard_one(a)) for a in data_arrs)
-        label_arr = _global_put(label_arr, _shard_one(label_arr))
+        data_sh, label_sh, sp_active = self._input_shardings(
+            data_arrs, label_arr)
+        overlapped = 0
+        placed = []
+        for a, s in zip(data_arrs, data_sh):
+            arr, pre = _maybe_put(a, s)
+            placed.append(arr)
+            if pre:
+                overlapped += int(getattr(arr, "nbytes", 0))
+        data_arrs = tuple(placed)
+        label_arr, pre = _maybe_put(label_arr, label_sh)
+        if pre:
+            overlapped += int(getattr(label_arr, "nbytes", 0))
         key = _random.next_key()
         # Pallas kernels must lower for the platform the MESH runs on (a CPU
         # mesh under a TPU default backend needs interpret mode); the flag is
@@ -544,6 +626,9 @@ class DataParallelStep:
                 np.float32(self._current_lr(self._step_count + 1)),
                 data_arrs, label_arr)
         self._step_count += 1
+        handle = AsyncLoss(loss, step=self._step_count, executor=name,
+                           ring=self._inflight, host_fn=_host_scalar)
+        depth = self._inflight.admit(handle) if limit > 0 else 0
         if telemetry.enabled():
             samples = int(np.shape(label_arr)[0]) if np.ndim(label_arr) else 1
             xfer = sum(int(getattr(a, "nbytes", 0))
@@ -551,9 +636,28 @@ class DataParallelStep:
             telemetry.record_step(name, step=self._step_count,
                                   wall_s=time.perf_counter() - t0,
                                   samples=samples, transfer_bytes=xfer,
-                                  traced=traced)
+                                  traced=traced, h2d_overlapped=overlapped,
+                                  inflight_depth=depth,
+                                  block_wait_ms=round(block_wait_s * 1e3, 3))
+            # (no record_block_wait here: make_room's internal wait()
+            # already recorded the blocked time — recording the returned
+            # duration again would double the rollup)
+            # heartbeat advances at DISPATCH, not readback: a supervisor
+            # watching a deeply pipelined rank must see it making progress
             telemetry.heartbeat(self._step_count)
-        return _host_scalar(loss)
+        if limit == 0:
+            handle.wait()  # synchronous mode: errors surface right here
+        return handle
+
+    def drain(self) -> None:
+        """Force every in-flight step (epoch end, pre-checkpoint, exit);
+        raises the first deferred failure."""
+        self._inflight.drain()
+
+    @property
+    def inflight_depth(self) -> int:
+        """Dispatched-but-unforced steps currently pending."""
+        return self._inflight.depth
 
     def _current_lr(self, num_update: int) -> float:
         if self._lr_scheduler is not None:
@@ -575,9 +679,12 @@ class DataParallelStep:
 
     # ------------------------------------------------------------------
     def sync_to_block(self) -> None:
-        """Write the sharded training state back into the Gluon parameters."""
+        """Write the sharded training state back into the Gluon parameters.
+        Drains the in-flight window first so a deferred step failure
+        surfaces here (named) instead of as a bare error mid-copy."""
         import jax
 
+        self.drain()
         for name, p in self._param_items:
             host = np.asarray(jax.device_get(self.params[name]))
             p.set_data(host)

@@ -59,9 +59,9 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 __all__ = ["enabled", "enable", "disable", "record", "record_step",
-           "record_collective", "record_fused_update", "heartbeat",
-           "note_signature", "summary", "flight_tail", "flush", "reset",
-           "rank", "event_path", "heartbeat_path", "RING_SIZE"]
+           "record_collective", "record_fused_update", "record_block_wait",
+           "heartbeat", "note_signature", "summary", "flight_tail", "flush",
+           "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -240,7 +240,8 @@ def record(kind: str, **fields) -> None:
 
 def record_step(executor: str, step: int, wall_s: float,
                 samples: Optional[int] = None, transfer_bytes: int = 0,
-                traced: bool = False, **fields) -> None:
+                traced: bool = False, h2d_overlapped: int = 0,
+                **fields) -> None:
     """One executor step.  ``traced=True`` marks a first-call/retrace step
     whose wall time includes trace+compile; those are aggregated separately
     so steady-state samples/sec is not polluted by compile time.
@@ -254,14 +255,19 @@ def record_step(executor: str, step: int, wall_s: float,
     cadence, so the AGGREGATES (mean_exec_ms, samples_per_sec over many
     steps) are meaningful while the first few per-step numbers undercount.
     For exact per-program device times use mx.profiler (its timed_call
-    blocks by design)."""
+    blocks by design).
+
+    ``h2d_overlapped`` counts the subset of ``transfer_bytes`` that a
+    device prefetcher staged in the background (already resident when the
+    step ran) — the async-pipeline overlap evidence.  Extra async fields
+    travel via ``**fields``: ``inflight_depth`` (pending window depth
+    after this dispatch) and ``block_wait_ms`` (time this dispatch spent
+    blocked because the window was full)."""
     if not _state.enabled:
         return
     wall_ms = wall_s * 1e3
     with _state.lock:
-        st = _state.steps.setdefault(executor, {
-            "count": 0, "compile_count": 0, "compile_ms": 0.0,
-            "exec_ms": 0.0, "samples": 0, "bytes": 0})
+        st = _state.steps.setdefault(executor, _new_step_agg())
         st["count"] += 1
         if traced:
             st["compile_count"] += 1
@@ -271,6 +277,7 @@ def record_step(executor: str, step: int, wall_s: float,
             if samples:
                 st["samples"] += int(samples)
         st["bytes"] += int(transfer_bytes)
+        st["overlap_bytes"] += int(h2d_overlapped)
     ev = dict(executor=executor, step=int(step), wall_ms=round(wall_ms, 3),
               traced=bool(traced), **fields)
     if samples is not None:
@@ -279,7 +286,29 @@ def record_step(executor: str, step: int, wall_s: float,
             ev["samples_per_sec"] = round(samples / wall_s, 2)
     if transfer_bytes:
         ev["transfer_bytes"] = int(transfer_bytes)
+    if h2d_overlapped:
+        ev["h2d_overlapped"] = int(h2d_overlapped)
     record("step", **ev)
+
+
+def _new_step_agg() -> Dict[str, float]:
+    return {"count": 0, "compile_count": 0, "compile_ms": 0.0,
+            "exec_ms": 0.0, "samples": 0, "bytes": 0,
+            "overlap_bytes": 0, "block_wait_ms": 0.0}
+
+
+def record_block_wait(executor: str, wall_s: float) -> None:
+    """Host time spent BLOCKED on the device for one executor: a forced
+    readback (``AsyncLoss.wait``), a full in-flight window, or a fence
+    sync.  Aggregate-only (no per-event line — a hot loop forces every
+    step); ``summary()['steps'][executor]['block_wait_ms']`` is the
+    rollup that shows how much wall time the host truly lost to the
+    device, the before/after number for the async pipeline."""
+    if not _state.enabled or wall_s <= 0:
+        return
+    with _state.lock:
+        st = _state.steps.setdefault(executor, _new_step_agg())
+        st["block_wait_ms"] += wall_s * 1e3
 
 
 def record_collective(op: str, nbytes: int, wall_s: float,
@@ -481,6 +510,8 @@ def summary() -> dict:
                 "compile_ms": round(st["compile_ms"], 3),
                 "exec_ms": round(st["exec_ms"], 3),
                 "transfer_bytes": st["bytes"],
+                "h2d_overlapped_bytes": st.get("overlap_bytes", 0),
+                "block_wait_ms": round(st.get("block_wait_ms", 0.0), 3),
             }
             if exec_count > 0:
                 row["mean_exec_ms"] = round(st["exec_ms"] / exec_count, 3)

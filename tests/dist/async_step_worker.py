@@ -1,0 +1,65 @@
+"""Async-pipeline-under-gang worker (docs/PERFORMANCE.md §Async pipeline):
+2 ranks drive a dp2 global mesh through DataParallelStep with
+MX_ASYNC_INFLIGHT=2 and DEFERRED readback — every loss is forced only
+after the whole epoch dispatched, so the readbacks cross the real Gloo
+mesh long after dispatch.  The worker then re-runs the identical schedule
+synchronously (MX_ASYNC_INFLIGHT=0) and asserts the per-step losses are
+bitwise identical: asynchrony changes when the host observes results,
+never what is computed — even multi-controller."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# one CPU device per process (a dp2 global mesh) BEFORE jax initializes:
+# the pytest parent's XLA_FLAGS asks for 8 virtual devices per host,
+# which a batch of 8 over 2 processes cannot shard
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402  (rendezvous runs at import)
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.parallel import DataParallelStep, make_mesh
+
+
+def _run(inflight, steps=4):
+    os.environ["MX_ASYNC_INFLIGHT"] = str(inflight)
+    import jax
+
+    mesh = make_mesh(devices=jax.devices())
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Normal(0.5))
+    loss_fn = gluon.loss.L2Loss()
+    step = DataParallelStep(net, loss_fn, mesh=mesh, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    handles = []
+    for _ in range(steps):
+        x = nd.array(rng.rand(8, 4).astype(np.float32))
+        y = nd.array(rng.rand(8, 4).astype(np.float32))
+        handles.append(step.step(x, y))
+    if inflight:
+        assert not handles[-1].forced, "async handle forced at dispatch"
+        assert 0 < step.inflight_depth <= inflight, step.inflight_depth
+    step.drain()  # every deferred readback crosses the Gloo mesh here
+    assert step.inflight_depth == 0
+    return [float(h) for h in handles]
+
+
+def main():
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    deferred = _run(2)
+    sync = _run(0)
+    assert all(np.isfinite(deferred)), deferred
+    assert deferred == sync, (deferred, sync)
+    print(f"worker {jax.process_index()}: async dist OK "
+          f"losses={','.join(f'{l:.6f}' for l in deferred)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

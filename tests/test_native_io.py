@@ -244,3 +244,83 @@ def test_prefetching_iter_error_and_exhaustion():
                              np.zeros(4, np.float32), batch_size=2)
     it2 = mx.io.PrefetchingIter(base, rename_data=[{"data": "x"}])
     assert it2.provide_data[0].name == "x"
+
+
+class _FlakyIter(mx.io.DataIter):
+    """Yields `good` batches, raises once at batch `fail_at` on the FIRST
+    epoch only, then behaves normally after reset()."""
+
+    def __init__(self, good=4, fail_at=None):
+        super().__init__(batch_size=2)
+        self._good, self._fail_at = good, fail_at
+        self._epoch, self.n = 0, 0
+
+    def reset(self):
+        self._epoch += 1
+        self.n = 0
+
+    def next(self):
+        from mxnet_tpu import nd
+
+        self.n += 1
+        if self._epoch == 0 and self._fail_at is not None \
+                and self.n == self._fail_at:
+            def inner():
+                raise ValueError("flaky worker boom")
+            inner()  # a real frame below, so the traceback has depth
+        if self.n > self._good:
+            raise StopIteration
+        return mx.io.DataBatch(
+            [nd.full((2, 3), float(self.n))],
+            [nd.full((2,), float(self.n))])
+
+
+def test_prefetching_iter_error_carries_worker_traceback():
+    import traceback
+
+    it = mx.io.PrefetchingIter(_FlakyIter(good=4, fail_at=1))
+    with pytest.raises(ValueError, match="flaky worker boom") as ei:
+        it.next()
+    # the ORIGINAL worker traceback rides along: the raising frame
+    # (inner, inside the wrapped iterator's next) is visible, not just
+    # the consumer-side re-raise site
+    frames = [f.name for f in traceback.extract_tb(ei.value.__traceback__)]
+    assert "inner" in frames and "next" in frames, frames
+    # exactly once: afterwards plain StopIteration, repeatably
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+
+
+def test_prefetching_iter_reset_after_worker_error_restarts_cleanly():
+    it = mx.io.PrefetchingIter(_FlakyIter(good=4, fail_at=3))
+    seen = [it.next().data[0].asnumpy()[0, 0] for _ in range(2)]
+    assert seen == [1.0, 2.0]
+    with pytest.raises(ValueError, match="flaky worker boom"):
+        while True:
+            it.next()
+    # regression: the _done/error interplay used to leave the iterator
+    # permanently exhausted here — reset() must produce a full epoch
+    it.reset()
+    vals = [b.data[0].asnumpy()[0, 0] for b in it]
+    assert vals == [1.0, 2.0, 3.0, 4.0]
+    # and another reset keeps working
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_prefetching_iter_reset_after_partial_consume():
+    it = mx.io.PrefetchingIter(_FlakyIter(good=6))
+    first = it.next().data[0].asnumpy()[0, 0]
+    assert first == 1.0
+    # reset mid-epoch while the worker holds prefetched batches: the next
+    # epoch must start from batch 1 with nothing stale, dropped, or
+    # double-consumed
+    it.reset()
+    vals = [b.data[0].asnumpy()[0, 0] for b in it]
+    assert vals == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    # immediate back-to-back resets don't wedge the generation machinery
+    it.reset()
+    it.reset()
+    assert sum(1 for _ in it) == 6
