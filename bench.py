@@ -335,6 +335,22 @@ def main():
             result["pipeline_overlap"] = pipe
             print(json.dumps(result), flush=True)
 
+    # telemetry_overhead: steps/sec with the recorder + span tracing ON vs
+    # fully off — the "observability must be cheap enough to leave on"
+    # claim (docs/OBSERVABILITY.md §Tracing) measured, not asserted.
+    # Values near 1.0 are the point; < 0.98 would mean the span layer
+    # costs more than its 2% budget on the toy net.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_TELEMETRY", "1") != "0"
+            and "error" not in result):
+        tovh = _run_child("cpu", float(os.environ.get(
+            "BENCH_TELEMETRY_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "telemetry_overhead"})
+        if tovh is not None:
+            tovh.pop("probe_history", None)
+            result["telemetry_overhead"] = tovh
+            print(json.dumps(result), flush=True)
+
 
 # ---------------------------------------------------------------------------
 # measurement children
@@ -740,6 +756,104 @@ def bench_pipeline_overlap(platform):
     }))
 
 
+def bench_telemetry_overhead(platform):
+    """Secondary metric: steady-state steps/sec with the telemetry
+    recorder + span tracing enabled (MX_TELEMETRY_DIR set, spans on — the
+    full ~8-events-per-step observability load) vs the recorder fully off,
+    best-of-N trials on a toy DataParallelStep net.  The acceptance bar
+    is < 2% overhead (value >= 0.98): tracing that perturbs the hot path
+    would get turned off in production, defeating its purpose.  The
+    per-mode span rollup rides along as evidence the spans actually
+    recorded."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    B = int(os.environ.get("BENCH_TELEMETRY_BATCH", 256))
+    D = int(os.environ.get("BENCH_TELEMETRY_DIM", 8192))
+    steps = int(os.environ.get("BENCH_TELEMETRY_STEPS", 8))
+    trials = int(os.environ.get("BENCH_TELEMETRY_TRIALS", 24))
+
+    rng = np.random.RandomState(0)
+    from mxnet_tpu import nd
+
+    x = nd.array(rng.rand(B, D).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, B).astype(np.float32))
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    step = DataParallelStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mesh=local_mesh(devices=[ctx.jax_device]), optimizer="sgd",
+        optimizer_params={"learning_rate": 1e-3})
+
+    import tempfile
+
+    tele_dir = tempfile.mkdtemp(prefix="bench_telemetry_")
+
+    def one_trial(enabled):
+        telemetry.reset()
+        if enabled:
+            telemetry.enable(tele_dir)
+        t0 = time.perf_counter()
+        loss = None
+        for _i in range(steps):
+            loss = step.step(x, y)
+        step.drain()
+        float(loss)
+        dt = time.perf_counter() - t0
+        n_spans = (sum(v["count"]
+                       for v in telemetry.summary()["spans"].values())
+                   if enabled else 0)
+        telemetry.reset()  # leave the recorder detached between trials
+        return dt, n_spans
+
+    # This 2-vCPU box drifts by 2x at sub-second scale (thermal/
+    # contention + XLA thread scheduling), far above the span layer's
+    # real cost — end-to-end trial means measure the machine, not the
+    # telemetry.  Instead: many short INTERLEAVED chunks per mode (both
+    # modes sample the same machine regimes) compared by INTERQUARTILE
+    # MEAN of chunk times — the middle half drops both the
+    # daemon-stomped chunks and the lucky turbo ones that keep fooling
+    # min/median estimators here.
+    one_trial(False)
+    one_trial(True)  # warm the compile cache + flusher thread
+    offs, ons, n_spans = [], [], 0
+    for _ in range(trials):
+        dt_off, _ = one_trial(False)
+        offs.append(dt_off)
+        dt_on, spans = one_trial(True)
+        ons.append(dt_on)
+        n_spans = max(n_spans, spans)
+
+    def iq_mean(xs):
+        xs = sorted(xs)
+        lo, hi = len(xs) // 4, max(len(xs) // 4 + 1, 3 * len(xs) // 4)
+        mid = xs[lo:hi]
+        return sum(mid) / len(mid)
+
+    iq_off, iq_on = iq_mean(offs), iq_mean(ons)
+    off_sps = steps / iq_off
+    on_sps = steps / iq_on
+    print(json.dumps({
+        "metric": "telemetry_overhead",
+        "value": round(iq_off / iq_on, 4),
+        "unit": "x_on_vs_off",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "on_steps_per_sec": round(on_sps, 2),
+        "off_steps_per_sec": round(off_sps, 2),
+        "spans_recorded": n_spans,
+        "batch": B, "dim": D, "steps": steps,
+    }))
+
+
 def child_main(platform):
     model = os.environ.get("BENCH_MODEL", "resnet")
     if model == "bert":
@@ -750,6 +864,8 @@ def child_main(platform):
         bench_trainer_overhead(platform)
     elif model == "pipeline_overlap":
         bench_pipeline_overlap(platform)
+    elif model == "telemetry_overhead":
+        bench_telemetry_overhead(platform)
     else:
         bench_resnet(platform)
 

@@ -244,6 +244,13 @@ class AsyncCheckpointer:
                 self._queue.task_done()
 
     def _write(self, snap):
+        # span runs on the writer thread (its own trace track): checkpoint
+        # wall never hides inside the training thread's step spans
+        with telemetry.span("checkpoint_save", paired=True,
+                            step=snap["step"]):
+            self._write_impl(snap)
+
+    def _write_impl(self, snap):
         from .ndarray import utils as nd_utils
         from . import ndarray as nd
 
@@ -426,6 +433,11 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
     the job unrecoverable.  With ``step=N`` the exact step is demanded and
     an invalid/missing step-N raises (gang-consistent resume must not
     silently diverge)."""
+    with telemetry.span("checkpoint_load", paired=True):
+        return _load_checkpoint_state(directory, step)
+
+
+def _load_checkpoint_state(directory: str, step: Optional[int] = None):
     from .ndarray import utils as nd_utils
 
     explicit = step is not None

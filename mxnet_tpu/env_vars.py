@@ -141,6 +141,30 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_TELEMETRY_RETRACE_LIMIT": (
         "honored", "distinct jit signatures one executor may accumulate "
         "before the retrace-storm warning fires (telemetry.py; default 5)"),
+    # gang-wide trace analysis (docs/OBSERVABILITY.md §Tracing & analysis)
+    "MX_TELEMETRY_SPANS": (
+        "honored", "0 disables span tracing (the nested "
+        "span_begin/span_end events threaded through "
+        "DataParallelStep.step, kvstore.push_bucketed, FusedUpdater, "
+        "checkpoints, and the async ring) while keeping step events and "
+        "heartbeats; default on whenever the recorder is on "
+        "(telemetry.py spans_enabled)"),
+    "MX_TRACE_EXPORT": (
+        "honored", "default off; 1/true exports a merged Chrome/Perfetto "
+        "trace.json (rank 0) plus per-rank OpenMetrics metrics-<R>.prom "
+        "snapshots into MX_TELEMETRY_DIR at process exit, any other "
+        "value names the target directory (telemetry.py "
+        "_trace_export_target)"),
+    "MX_TRACE_WINDOW": (
+        "honored", "sliding window of newest steady steps tools/"
+        "trace_report.py uses for the per-rank skew table (default 20)"),
+    "MX_TRACE_STRAGGLER_PCT": (
+        "honored", "trace_report.py flags a rank slower (step-wall rule) "
+        "or idler (idle-gap rule) than the best rank by more than this "
+        "percent (default 25)"),
+    "MX_TRACE_HEARTBEAT_GAP_SEC": (
+        "honored", "trace_report.py flags stretches where a rank's event "
+        "stream went silent longer than this many seconds (default 30)"),
 }
 
 _warned = False

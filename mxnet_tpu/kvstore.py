@@ -23,6 +23,7 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, List, Optional, Union
 
+from . import telemetry
 from .base import MXNetError
 
 __all__ = ["KVStore", "create"]
@@ -137,38 +138,39 @@ class KVStore:
             return 0
         from .ndarray.sparse import BaseSparseNDArray
 
-        groups: Dict[Any, List] = {}  # (ctx tuple, dtype) -> [(k, vals)]
-        fallback: List = []
-        for k, v in zip(keys, values):
-            vals = _as_list(v)
-            lead = vals[0]
-            if (any(isinstance(x, BaseSparseNDArray) for x in vals)
-                    or any(x._data.dtype != lead._data.dtype
-                           or x.shape != lead.shape for x in vals[1:])):
-                fallback.append((k, vals))
-                continue
-            gkey = (tuple(x.context for x in vals), str(lead._data.dtype))
-            groups.setdefault(gkey, []).append((k, vals))
-        n_buckets = 0
-        merged_kv: List = []  # (k, merged NDArray) in caller key order
-        for (_ctxs, _dt), items in groups.items():
-            bucket: List = []
-            nbytes = 0
-            for k, vals in items:
-                sz = int(vals[0].size) * vals[0]._data.dtype.itemsize
-                if bucket and nbytes + sz > cap:
+        with telemetry.span("push_bucketed", n_keys=len(keys)):
+            groups: Dict[Any, List] = {}  # (ctx tuple, dtype) -> [(k, vals)]
+            fallback: List = []
+            for k, v in zip(keys, values):
+                vals = _as_list(v)
+                lead = vals[0]
+                if (any(isinstance(x, BaseSparseNDArray) for x in vals)
+                        or any(x._data.dtype != lead._data.dtype
+                               or x.shape != lead.shape for x in vals[1:])):
+                    fallback.append((k, vals))
+                    continue
+                gkey = (tuple(x.context for x in vals), str(lead._data.dtype))
+                groups.setdefault(gkey, []).append((k, vals))
+            n_buckets = 0
+            merged_kv: List = []  # (k, merged NDArray) in caller key order
+            for (_ctxs, _dt), items in groups.items():
+                bucket: List = []
+                nbytes = 0
+                for k, vals in items:
+                    sz = int(vals[0].size) * vals[0]._data.dtype.itemsize
+                    if bucket and nbytes + sz > cap:
+                        merged_kv.extend(self._reduce_bucket(bucket))
+                        n_buckets += 1
+                        bucket, nbytes = [], 0
+                    bucket.append((k, vals))
+                    nbytes += sz
+                if bucket:
                     merged_kv.extend(self._reduce_bucket(bucket))
                     n_buckets += 1
-                    bucket, nbytes = [], 0
-                bucket.append((k, vals))
-                nbytes += sz
-            if bucket:
-                merged_kv.extend(self._reduce_bucket(bucket))
-                n_buckets += 1
-        self._store_merged(merged_kv)
-        for k, vals in fallback:
-            self.push(k, vals, priority)
-        return n_buckets
+            self._store_merged(merged_kv)
+            for k, vals in fallback:
+                self.push(k, vals, priority)
+            return n_buckets
 
     def _reduce_bucket(self, bucket) -> List:
         """Reduce one flat bucket across devices (and hosts for dist_*);
@@ -180,21 +182,27 @@ class KVStore:
         if len(bucket) == 1:
             # a bucket of one key gains nothing from the flatten round-trip
             k, vals = bucket[0]
-            merged = self._reduce(vals)
-            if self._type.startswith("dist") and self.num_workers > 1:
-                merged = self._global_sum(merged)
+            with telemetry.span("bucket_collective", paired=True, n_keys=1):
+                merged = self._reduce(vals)
+                if self._type.startswith("dist") and self.num_workers > 1:
+                    merged = self._global_sum(merged)
             return [(k, merged)]
         ndev = len(bucket[0][1])
-        flats = []
-        for d in range(ndev):
-            flat = flatten_bucket([vals[d]._data for _k, vals in bucket])
-            flats.append(NDArray(flat, ctx=bucket[0][1][d].context))
-        merged = self._reduce(flats)
-        if self._type.startswith("dist") and self.num_workers > 1:
-            merged = self._global_sum(merged)
-        segments = unflatten_bucket(merged._data, shapes)
-        return [(k, NDArray(seg, ctx=merged.context))
-                for (k, _vals), seg in zip(bucket, segments)]
+        with telemetry.span("bucket_flatten", n_keys=len(bucket)):
+            flats = []
+            for d in range(ndev):
+                flat = flatten_bucket([vals[d]._data for _k, vals in bucket])
+                flats.append(NDArray(flat, ctx=bucket[0][1][d].context))
+        with telemetry.span("bucket_collective", paired=True,
+                            n_keys=len(bucket)):
+            merged = self._reduce(flats)
+            if self._type.startswith("dist") and self.num_workers > 1:
+                merged = self._global_sum(merged)
+        with telemetry.span("bucket_unflatten", n_keys=len(bucket)):
+            segments = unflatten_bucket(merged._data, shapes)
+            out = [(k, NDArray(seg, ctx=merged.context))
+                   for (k, _vals), seg in zip(bucket, segments)]
+        return out
 
     def _store_merged(self, merged_kv) -> None:
         """The tail of ``push`` for already-reduced values: store them, or

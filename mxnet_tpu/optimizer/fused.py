@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import engine
+from .. import telemetry
 from .optimizer import Optimizer, Updater
 
 __all__ = ["FusedUpdater", "fused_enabled"]
@@ -327,6 +328,10 @@ class FusedUpdater(Updater):
         parameters, NOT for kvstore-stored values aliased by pulls).
         Returns (and stores in ``last_info``) the dispatch accounting.
         """
+        with telemetry.span("fused_apply", n_params=len(entries)):
+            return self._apply_impl(entries, donate)
+
+    def _apply_impl(self, entries, donate: bool) -> Dict[str, int]:
         _dense, sparse_cls = _nd_classes()
         opt = self.optimizer
         spec = _SPECS.get(type(opt).__name__)

@@ -36,6 +36,7 @@ deferred step raises surface at the forcing site, wrapped in an
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -95,34 +96,47 @@ class _PendingHandle:
     def _force(self):
         raise NotImplementedError
 
-    def wait(self):
+    def wait(self, _span: bool = True):
         """Force the readback/sync.  Blocks until the device has produced
         this step's result; re-raises (wrapped) anything the deferred
         computation failed with, naming the dispatching step.  Idempotent:
-        later calls return the cached host value (or re-raise)."""
+        later calls return the cached host value (or re-raise).
+
+        ``_span=False`` skips the ``loss_wait`` span (NOT the aggregate
+        rollup) for callers that record the same blocked interval under
+        their own span — one wall fact must reach the phase breakdown
+        once."""
         if self._forced:
             if self._exc is not None:
                 raise self._exc
             return self._host
-        t0 = time.perf_counter()
-        try:
-            self._host = self._force()
-            return self._host
-        except Exception as exc:
-            # the failure belongs to the step that DISPATCHED the program,
-            # not to whatever line happened to force it much later
-            self._exc = MXNetError(
-                f"async step {self._step} dispatched by {self._executor} "
-                f"failed at deferred readback: {exc}")
-            raise self._exc from exc
-        finally:
-            self._forced = True
-            if self._ring is not None:
-                self._ring.discard(self)
-            # all host time spent blocked on the device funnels into one
-            # per-executor rollup (summary()['steps'][name]['block_wait_ms'])
-            telemetry.record_block_wait(self._executor,
-                                        time.perf_counter() - t0)
+        # the span makes the host's device-blocked time VISIBLE on the
+        # trace timeline (trace_report's idle-gap straggler rule relies on
+        # waits being accounted); the aggregate rollup below stays the
+        # cheap always-on form
+        with (telemetry.span("loss_wait", paired=True,
+                             executor=self._executor, step=self._step)
+              if _span else contextlib.nullcontext()):
+            t0 = time.perf_counter()
+            try:
+                self._host = self._force()
+                return self._host
+            except Exception as exc:
+                # the failure belongs to the step that DISPATCHED the
+                # program, not to whatever line happened to force it later
+                self._exc = MXNetError(
+                    f"async step {self._step} dispatched by "
+                    f"{self._executor} failed at deferred readback: {exc}")
+                raise self._exc from exc
+            finally:
+                self._forced = True
+                if self._ring is not None:
+                    self._ring.discard(self)
+                # all host time spent blocked on the device funnels into
+                # one per-executor rollup
+                # (summary()['steps'][name]['block_wait_ms'])
+                telemetry.record_block_wait(self._executor,
+                                            time.perf_counter() - t0)
 
     def __repr__(self):
         state = "forced" if self._forced else "pending"
@@ -218,16 +232,19 @@ class InflightRing:
                 return None
             return self._pending[0]
 
-    def make_room(self, limit: int) -> float:
+    def make_room(self, limit: int, wait_span: bool = True) -> float:
         """Ensure the window has a free slot; returns seconds spent
-        blocked (0.0 when the ring wasn't full)."""
+        blocked (0.0 when the ring wasn't full).  ``wait_span=False``
+        suppresses the inner waits' ``loss_wait`` spans for a caller that
+        records the returned duration as its own ``block_wait`` span —
+        the same blocked wall must not land in the trace twice."""
         waited = 0.0
         while True:
             oldest = self._oldest_over(limit)
             if oldest is None:
                 return waited
             t0 = time.perf_counter()
-            oldest.wait()  # discards itself from the ring
+            oldest.wait(_span=wait_span)  # discards itself from the ring
             waited += time.perf_counter() - t0
 
     def admit(self, handle) -> int:
@@ -243,14 +260,18 @@ class InflightRing:
     def drain(self) -> None:
         """Force every pending handle, oldest first (epoch end, shutdown,
         checkpoint sync).  Raises the first deferred failure it hits."""
-        while True:
-            with self._lock:
-                while self._pending and self._pending[0].forced:
-                    self._pending.popleft()
-                if not self._pending:
-                    return
-                oldest = self._pending[0]
-            oldest.wait()
+        if self.depth == 0:
+            return  # no span noise for the common already-empty drain
+        with telemetry.span("inflight_drain", paired=True,
+                            executor=self._executor):
+            while True:
+                with self._lock:
+                    while self._pending and self._pending[0].forced:
+                        self._pending.popleft()
+                    if not self._pending:
+                        return
+                    oldest = self._pending[0]
+                oldest.wait()
 
 
 def drain_all():

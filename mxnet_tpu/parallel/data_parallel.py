@@ -522,7 +522,18 @@ class DataParallelStep:
         numbers: asynchrony never changes what is computed).
 
         `data` may be a single NDArray or a tuple/list of NDArrays for
-        multi-input blocks (e.g. the seq2seq Transformer's (src, tgt))."""
+        multi-input blocks (e.g. the seq2seq Transformer's (src, tgt)).
+
+        With telemetry spans on (docs/OBSERVABILITY.md §Tracing), the call
+        is traced as a ``train_step`` span with ``block_wait`` /
+        ``input_stage`` / ``dispatch`` sub-spans — the per-phase timing
+        ``tools/trace_report.py`` aggregates into the gang-wide step
+        breakdown.  Spans observe only; the computation is bitwise
+        identical with ``MX_TELEMETRY_SPANS=0``."""
+        with telemetry.span("train_step", executor=self._tele_name):
+            return self._step_impl(data, label)
+
+    def _step_impl(self, data, label):
         import jax
 
         from .. import random as _random
@@ -559,22 +570,36 @@ class DataParallelStep:
         # ring is full, BEFORE paying this batch's placement — the
         # remaining in-flight steps keep the device busy meanwhile
         limit = inflight_limit()
-        block_wait_s = (self._inflight.make_room(limit) if limit > 0 else 0.0)
-        data_arrs = tuple(d._data for d in datas)
-        label_arr = label._data if isinstance(label, NDArray) else label
-        data_sh, label_sh, sp_active = self._input_shardings(
-            data_arrs, label_arr)
-        overlapped = 0
-        placed = []
-        for a, s in zip(data_arrs, data_sh):
-            arr, pre = _maybe_put(a, s)
-            placed.append(arr)
+        block_wait_s = 0.0
+        if limit > 0:
+            bw0 = time.perf_counter()
+            # wait_span=False: the interval below IS this step's
+            # block_wait span; the inner wait emitting loss_wait over the
+            # same wall would double-count the phase breakdown
+            block_wait_s = self._inflight.make_room(limit,
+                                                    wait_span=False)
+            if block_wait_s > 0.0:
+                # retro span: a non-blocking make_room (the common case
+                # once the pipeline is in steady state with a free slot)
+                # must not pay a begin/end event pair for a 0ms fact
+                telemetry.record_span("block_wait", bw0,
+                                      bw0 + block_wait_s)
+        with telemetry.span("input_stage"):
+            data_arrs = tuple(d._data for d in datas)
+            label_arr = label._data if isinstance(label, NDArray) else label
+            data_sh, label_sh, sp_active = self._input_shardings(
+                data_arrs, label_arr)
+            overlapped = 0
+            placed = []
+            for a, s in zip(data_arrs, data_sh):
+                arr, pre = _maybe_put(a, s)
+                placed.append(arr)
+                if pre:
+                    overlapped += int(getattr(arr, "nbytes", 0))
+            data_arrs = tuple(placed)
+            label_arr, pre = _maybe_put(label_arr, label_sh)
             if pre:
-                overlapped += int(getattr(arr, "nbytes", 0))
-        data_arrs = tuple(placed)
-        label_arr, pre = _maybe_put(label_arr, label_sh)
-        if pre:
-            overlapped += int(getattr(label_arr, "nbytes", 0))
+                overlapped += int(getattr(label_arr, "nbytes", 0))
         key = _random.next_key()
         # Pallas kernels must lower for the platform the MESH runs on (a CPU
         # mesh under a TPU default backend needs interpret mode); the flag is
@@ -615,16 +640,18 @@ class DataParallelStep:
         else:
             pp_cm = contextlib.nullcontext()
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
-        with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
-            run = self._jitted
-            if profiler.is_recording():
-                run = (lambda *a: profiler.timed_call(
-                    f"FusedStep:{type(self.block).__name__}",
-                    self._jitted, *a))
-            self.params, self.opt_state, loss = run(
-                self.params, self.opt_state, key,
-                np.float32(self._current_lr(self._step_count + 1)),
-                data_arrs, label_arr)
+        with telemetry.span("dispatch", step=self._step_count + 1,
+                            traced=traced):
+            with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
+                run = self._jitted
+                if profiler.is_recording():
+                    run = (lambda *a: profiler.timed_call(
+                        f"FusedStep:{type(self.block).__name__}",
+                        self._jitted, *a))
+                self.params, self.opt_state, loss = run(
+                    self.params, self.opt_state, key,
+                    np.float32(self._current_lr(self._step_count + 1)),
+                    data_arrs, label_arr)
         self._step_count += 1
         handle = AsyncLoss(loss, step=self._step_count, executor=name,
                            ring=self._inflight, host_fn=_host_scalar)

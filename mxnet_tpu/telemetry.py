@@ -46,10 +46,29 @@ Events buffer in memory (bounded) and a daemon thread flushes them every
 ``MX_TELEMETRY_FLUSH_SEC`` seconds; the last ``RING_SIZE`` events also live
 in an in-process ring (the flight recorder) surfaced by ``summary()`` /
 ``flight_tail()``.
+
+**Span tracing** (docs/OBSERVABILITY.md §Tracing & analysis): ``span(name,
+**attrs)`` is a context manager emitting nested span events stamped with
+the per-process monotonic clock (``mono``) so regions order exactly even
+when the wall clock steps — one complete ``span`` event per region on hot
+paths, or ``span_begin``/``span_end`` pairs (``paired=True``) for blocking
+regions whose still-open begin is the flight-recorder's "died inside X"
+clue.  A ``clock_anchor``
+event — a ``(time.time(), perf_counter())`` pair written at enable() and
+re-emitted on every flush — lets the analysis side (``export_chrome_trace``,
+``tools/trace_report.py``) merge per-rank files onto ONE wall timeline
+despite rank start-time skew.  Spans are on whenever the recorder is on;
+``MX_TELEMETRY_SPANS=0`` is the kill switch.  ``export_chrome_trace(dir)``
+merges every rank's stream into a Chrome/Perfetto trace-event JSON (one
+track per rank, spans nested, collectives as flow events);
+``export_prometheus(path)`` writes an OpenMetrics text snapshot of the
+``summary()`` rollups for production scraping.  ``MX_TRACE_EXPORT``
+(default off) runs both automatically at process exit.
 """
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import logging
 import os
@@ -61,16 +80,22 @@ from typing import Any, Dict, List, Optional
 __all__ = ["enabled", "enable", "disable", "record", "record_step",
            "record_collective", "record_fused_update", "record_block_wait",
            "heartbeat", "note_signature", "summary", "flight_tail", "flush",
-           "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE"]
+           "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE",
+           "span", "record_span", "spans_enabled", "export_chrome_trace",
+           "export_prometheus"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
 # flight-recorder depth (in-process ring; the supervisor reads the JSONL
 # file's tail instead, so this only bounds summary()/flight_tail())
 RING_SIZE = 256
-# force an inline flush when this many events are pending (bounds memory
-# between flusher wakeups under event bursts)
+# nudge the flusher thread awake when this many events are pending, so
+# serialization + disk I/O happen OFF the hot path (span tracing at ~10
+# events/step would otherwise pay an inline flush every dozen steps)
 _FLUSH_PENDING_MAX = 128
+# hard backstop: if the flusher thread cannot keep up (or died), the
+# recording thread flushes inline rather than growing memory unbounded
+_FLUSH_PENDING_HARD = 4096
 # distinct jit signatures one executor may accumulate before the retrace
 # warning fires (override: MX_TELEMETRY_RETRACE_LIMIT)
 _RETRACE_LIMIT_DEFAULT = 5
@@ -118,7 +143,9 @@ class _State:
         self.rank: int = 0
         self.enabled = False
         self.ring: deque = deque(maxlen=RING_SIZE)
-        self.pending: List[str] = []
+        # pending holds raw event DICTS: json serialization happens at
+        # flush time (flusher thread / atexit), not on the hot path
+        self.pending: List[dict] = []
         self.counts: Dict[str, int] = {}
         # executor -> {count, first_ms, total_ms, samples, bytes}
         self.steps: Dict[str, Dict[str, float]] = {}
@@ -131,10 +158,16 @@ class _State:
         # executor -> {"sigs": set, "traces": int, "warned_at": int,
         #              "last_sig": str}
         self.retraces: Dict[str, Dict[str, Any]] = {}
+        # span name -> {count, total_ms, max_ms}
+        self.spans: Dict[str, Dict[str, float]] = {}
         self.flusher: Optional[threading.Thread] = None
+        # record() sets this when pending crosses _FLUSH_PENDING_MAX so
+        # the flusher wakes immediately instead of at its next cadence
+        self.flush_wake = threading.Event()
         self.flush_sec = 1.0
         self.hb_interval = 5.0
         self.hb_last = 0.0
+        self.hb_wall = 0.0
         self.hb_step = -1
 
 
@@ -167,6 +200,11 @@ def enable(directory: Optional[str] = None) -> None:
             _state.flusher.start()
     record("start", pid=os.getpid(),
            restart=int(os.environ.get("MX_RESTART_COUNT", "0") or 0))
+    # wall<->monotonic anchor: the merge key export_chrome_trace /
+    # trace_report use to put every rank's mono-stamped spans on one wall
+    # timeline (re-emitted on each flush — see flush())
+    record("clock_anchor", wall=round(time.time(), 6),
+           mono=round(time.perf_counter(), 6))
 
 
 def disable() -> None:
@@ -188,7 +226,8 @@ def reset() -> None:
 
 def _flusher_loop() -> None:
     while True:
-        time.sleep(_state.flush_sec)
+        _state.flush_wake.wait(_state.flush_sec)
+        _state.flush_wake.clear()
         try:
             flush()
         except Exception:  # a full disk must not kill the training process
@@ -196,14 +235,37 @@ def _flusher_loop() -> None:
 
 
 def flush() -> None:
-    """Append pending events to this rank's JSONL file."""
+    """Append pending events to this rank's JSONL file.  Every batch ends
+    with a fresh ``clock_anchor`` line (wall + monotonic pair): anchors are
+    re-emitted so a merged-trace reader always finds one near the events it
+    aligns, tolerating rank start-time skew and wall-clock steps."""
     st = _state
-    with st.lock:
-        if not st.pending or st.dir is None:
-            return
-        lines, st.pending = st.pending, []
-        path = event_path(st.dir, st.rank)
-    with st.write_lock:  # whole-batch append; no mid-line interleaving
+    # write_lock brackets snapshot + serialize + append: two concurrent
+    # flushes (flusher thread vs the 4096-pending backstop or atexit)
+    # must not reorder batches on disk — a span_begin landing after its
+    # span_end would silently drop the pair from every trace consumer.
+    # record() never touches write_lock, so the hot path is unaffected.
+    with st.write_lock:
+        with st.lock:
+            if not st.pending or st.dir is None:
+                return
+            events, st.pending = st.pending, []
+            path = event_path(st.dir, st.rank)
+            rank_id = st.rank
+        lines = []
+        for ev in events:
+            try:
+                lines.append(json.dumps(ev) + "\n")
+            except (TypeError, ValueError):
+                ev = {k: (v if isinstance(v, (int, float, str, bool,
+                                              type(None)))
+                          else str(v)) for k, v in ev.items()}
+                lines.append(json.dumps(ev) + "\n")
+        wall = time.time()
+        lines.append(json.dumps(
+            {"t": round(wall, 4), "kind": "clock_anchor", "rank": rank_id,
+             "wall": round(wall, 6),
+             "mono": round(time.perf_counter(), 6)}) + "\n")
         try:
             with open(path, "a") as f:
                 f.write("".join(lines))
@@ -215,27 +277,179 @@ atexit.register(flush)
 
 
 # ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+_SPAN_IDS = itertools.count(1)
+_span_local = threading.local()  # per-thread nesting stack of span ids
+
+
+def spans_enabled() -> bool:
+    """Spans ride the recorder: on whenever telemetry is on, unless
+    ``MX_TELEMETRY_SPANS=0`` kills them (the knob exists so a production
+    run can keep step events + heartbeats while dropping the ~8 extra
+    events per step the span layer adds)."""
+    if not _state.enabled:
+        return False
+    return os.environ.get("MX_TELEMETRY_SPANS", "1").lower() not in (
+        "0", "false", "off")
+
+
+class _NullSpan:
+    """Shared no-op context manager: span() allocates nothing when off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_name", "_attrs", "_id", "_t0", "_parent", "_depth",
+                 "_paired")
+
+    def __init__(self, name: str, attrs: dict, paired: bool):
+        self._name = name
+        self._attrs = attrs
+        self._paired = paired
+
+    def __enter__(self):
+        stack = getattr(_span_local, "stack", None)
+        if stack is None:
+            stack = _span_local.stack = []
+        self._id = next(_SPAN_IDS)
+        self._parent = stack[-1] if stack else 0
+        self._depth = len(stack)
+        stack.append(self._id)
+        self._t0 = time.perf_counter()
+        if self._paired:
+            # mono is THE ordering/merge key (export_chrome_trace aligns
+            # it to the gang wall timeline via the clock_anchor events);
+            # the event's own "t" stays the wall stamp for humans reading
+            # raw JSONL
+            record("span_begin", name=self._name, span=self._id,
+                   parent=self._parent, depth=self._depth,
+                   tid=threading.get_ident(),
+                   mono=round(self._t0, 6), **self._attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        dur_ms = (t1 - self._t0) * 1e3
+        stack = getattr(_span_local, "stack", None)
+        if stack and stack[-1] == self._id:
+            stack.pop()
+        elif stack and self._id in stack:
+            # a nested span leaked past its parent's exit (exception taking
+            # a non-local path): unwind to self so nesting self-heals
+            del stack[stack.index(self._id):]
+        with _state.lock:
+            agg = _state.spans.setdefault(
+                self._name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+            agg["count"] += 1
+            agg["total_ms"] += dur_ms
+            agg["max_ms"] = max(agg["max_ms"], dur_ms)
+        if self._paired:
+            end = dict(name=self._name, span=self._id,
+                       tid=threading.get_ident(), mono=round(t1, 6),
+                       dur_ms=round(dur_ms, 3))
+            if exc_type is not None:
+                end["error"] = exc_type.__name__
+            record("span_end", **end)
+        else:
+            # one complete event for the whole region: half the event
+            # volume of a begin/end pair — the hot-path per-step form
+            ev = dict(name=self._name, span=self._id, parent=self._parent,
+                      depth=self._depth, tid=threading.get_ident(),
+                      mono=round(self._t0, 6), dur_ms=round(dur_ms, 3),
+                      **self._attrs)
+            if exc_type is not None:
+                ev["error"] = exc_type.__name__
+            record("span", **ev)
+        return False
+
+
+def record_span(name: str, t0: float, t1: float, **attrs) -> None:
+    """Retroactively emit one completed span from a measured
+    ``perf_counter`` interval — the zero-cost-when-idle form for hot-path
+    waits that usually DON'T happen (a non-blocking ``make_room``): the
+    caller times the interval with two perf_counter reads and records a
+    span only when it actually waited, instead of paying events per step
+    for a 0ms fact.  Emitted with correct nesting metadata (parent = the
+    caller's current open span) so the merged trace renders it exactly
+    like a ``span()`` region."""
+    if not spans_enabled():
+        return
+    dur_ms = (t1 - t0) * 1e3
+    sid = next(_SPAN_IDS)
+    stack = getattr(_span_local, "stack", None)
+    parent = stack[-1] if stack else 0
+    depth = len(stack) if stack else 0
+    record("span", name=name, span=sid, parent=parent, depth=depth,
+           tid=threading.get_ident(), mono=round(t0, 6),
+           dur_ms=round(dur_ms, 3), **attrs)
+    with _state.lock:
+        agg = _state.spans.setdefault(
+            name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        agg["count"] += 1
+        agg["total_ms"] += dur_ms
+        agg["max_ms"] = max(agg["max_ms"], dur_ms)
+
+
+def span(name: str, paired: bool = False, **attrs):
+    """Context manager tracing one nested timing region, carrying a span
+    id, the parent span's id, nesting ``depth``, the thread id, and the
+    monotonic clock — everything ``export_chrome_trace`` /
+    ``tools/trace_report.py`` need to rebuild the gang timeline.  Returns
+    a shared no-op object when spans are off, so hot paths pay one env
+    check when disabled.
+
+    By default the whole region lands as ONE complete ``span`` event at
+    exit (half the event volume — the per-step hot-path form).
+    ``paired=True`` emits ``span_begin``/``span_end`` events instead: use
+    it for regions that BLOCK (device waits, collectives, checkpoint
+    I/O), where a crashed/hung rank's flight-recorder tail must show the
+    still-open ``span_begin`` — "died inside X" is the post-mortem
+    answer.  (``paired`` is reserved; it cannot be used as an attr name.)
+
+    Spans measure HOST wall between enter and exit: around an async jax
+    dispatch that is dispatch cost, not device time (the same contract as
+    ``record_step`` — see its docstring)."""
+    if not spans_enabled():
+        return _NULL_SPAN
+    return _Span(name, attrs, paired)
+
+
+# ---------------------------------------------------------------------------
 # event recording
 # ---------------------------------------------------------------------------
 def record(kind: str, **fields) -> None:
-    """Record one event.  No-op unless the recorder is enabled."""
+    """Record one event.  No-op unless the recorder is enabled.
+
+    Span begin/end events skip the in-process flight ring: at ~8 per step
+    they would evict the step/collective/checkpoint history the ring
+    exists to preserve for post-mortems.  They still hit the JSONL sink
+    (the analysis surface) and the ``summary()`` span aggregates."""
     if not _state.enabled:
         return
     ev = {"t": round(time.time(), 4), "kind": kind, "rank": _state.rank}
     ev.update(fields)
-    try:
-        line = json.dumps(ev) + "\n"
-    except (TypeError, ValueError):
-        ev = {k: (v if isinstance(v, (int, float, str, bool, type(None)))
-                  else str(v)) for k, v in ev.items()}
-        line = json.dumps(ev) + "\n"
     with _state.lock:
         _state.counts[kind] = _state.counts.get(kind, 0) + 1
-        _state.ring.append(ev)
-        _state.pending.append(line)
-        inline_flush = len(_state.pending) >= _FLUSH_PENDING_MAX
-    if inline_flush:
-        flush()
+        if not kind.startswith("span"):
+            _state.ring.append(ev)
+        _state.pending.append(ev)
+        n_pending = len(_state.pending)
+    if n_pending >= _FLUSH_PENDING_MAX:
+        if n_pending >= _FLUSH_PENDING_HARD or _state.flusher is None:
+            flush()  # backstop: never let a stalled flusher grow memory
+        else:
+            _state.flush_wake.set()  # serialization + I/O off the hot path
 
 
 def record_step(executor: str, step: int, wall_s: float,
@@ -394,6 +608,9 @@ def heartbeat(step: int, force: bool = False) -> None:
                 now - _state.hb_last < _state.hb_interval:
             return
         _state.hb_last = now
+        # wall stamp of the newest beat: export_prometheus derives the
+        # mx_heartbeat_age_seconds gauge from it
+        _state.hb_wall = time.time()
         step = _state.hb_step = max(int(step), _state.hb_step)
         directory, rank_id = _state.dir, _state.rank
     payload = {"rank": rank_id, "step": int(step),
@@ -538,11 +755,336 @@ def summary() -> dict:
             "checkpoints": {k: (round(v, 3) if isinstance(v, float) else v)
                             for k, v in _state.ckpt.items()},
             "fused_update": dict(_state.fused),
+            "spans": {
+                name: {"count": agg["count"],
+                       "total_ms": round(agg["total_ms"], 3),
+                       "max_ms": round(agg["max_ms"], 3)}
+                for name, agg in _state.spans.items()
+            },
             "retraces": retraces,
             "restart_count": int(
                 os.environ.get("MX_RESTART_COUNT", "0") or 0),
         }
     return out
+
+
+# ---------------------------------------------------------------------------
+# exporters (docs/OBSERVABILITY.md §Tracing & analysis)
+# ---------------------------------------------------------------------------
+def _iter_rank_files(directory: str):
+    """(rank, path) for every rank-<R>.jsonl under ``directory``."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return
+    for name in names:
+        if name.startswith("rank-") and name.endswith(".jsonl"):
+            try:
+                r = int(name[len("rank-"):-len(".jsonl")])
+            except ValueError:
+                continue
+            yield r, os.path.join(directory, name)
+
+
+def _load_rank_events(path: str) -> List[dict]:
+    events = []
+    try:
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn final line of a SIGKILLed rank
+                if isinstance(ev, dict):
+                    events.append(ev)
+    except OSError:
+        pass
+    return events
+
+
+def _mono_offset(events: List[dict], rank_id) -> float:
+    """Fallback wall - perf_counter offset for an old-format stream with
+    NO ``clock_anchor`` events (anchored streams align to the nearest
+    preceding anchor in export_chrome_trace instead): derived from the
+    first mono-stamped event's own wall stamp, with a warning —
+    alignment then absorbs that event's record->flush latency."""
+    for e in events:
+        if "mono" in e and "t" in e:
+            _LOG.warning(
+                "rank %s stream has no clock_anchor events (old-format "
+                "file?): aligning its spans from event wall stamps — "
+                "cross-rank timeline may be skewed by flush latency",
+                rank_id)
+            return float(e["t"]) - float(e["mono"])
+    return 0.0
+
+
+def export_chrome_trace(directory: Optional[str] = None,
+                        out: Optional[str] = None) -> Optional[str]:
+    """Merge every rank's JSONL stream under ``directory`` (default: the
+    live recorder's dir) into ONE Chrome/Perfetto trace-event JSON at
+    ``out`` (default ``<directory>/trace.json``) and return its path.
+
+    Layout: one track (pid) per rank, named ``rank R``; paired
+    ``span_begin``/``span_end`` events become nested B/E duration events
+    per thread (only COMPLETED spans are emitted, so every B has a
+    matching E), complete-form ``span`` events become "X" slices (ts +
+    dur — written at exit, so a synthesized pair could mis-order on a µs
+    tie; X slices cannot be imbalanced); collectives become per-rank "X"
+    complete events chained across ranks by flow events
+    (``s``/``t``/``f`` sharing an id per occurrence of each op), so the
+    gang-wide shape of an allreduce is one connected arrow in the
+    Perfetto UI.  Monotonic span stamps align to the shared wall timeline
+    via each rank's ``clock_anchor`` offset.  Returns None when no rank
+    stream exists."""
+    directory = directory or _state.dir
+    if not directory:
+        return None
+    flush()  # this process's own stream must include the latest events
+    trace: List[dict] = []
+    coll_occurrence: Dict[Any, int] = {}  # op -> running flow id per rank
+    any_events = False
+    for rank_id, path in _iter_rank_files(directory):
+        events = _load_rank_events(path)
+        if not events:
+            continue
+        any_events = True
+        # supervised restarts APPEND to the same rank file, so one stream
+        # can hold several perf_counter epochs; a single whole-stream
+        # offset would shift one epoch's spans by the inter-process-start
+        # delta.  Track the NEAREST PRECEDING anchor in file order
+        # instead: anchors are re-emitted per flush, so every epoch's
+        # events follow an anchor of their own epoch.
+        anchor_offs = [float(e["wall"]) - float(e["mono"]) for e in events
+                       if e.get("kind") == "clock_anchor"
+                       and "wall" in e and "mono" in e]
+        offset = (anchor_offs[0] if anchor_offs
+                  else _mono_offset(events, rank_id))
+        trace.append({"ph": "M", "name": "process_name", "pid": rank_id,
+                      "tid": 0, "args": {"name": f"rank {rank_id}"}})
+        open_spans: Dict[Any, dict] = {}
+        tids: Dict[Any, int] = {}
+        n_coll: Dict[str, int] = {}
+        def span_args(begin: dict) -> dict:
+            args = {k: v for k, v in begin.items()
+                    if k not in ("t", "kind", "rank", "name", "span",
+                                 "parent", "depth", "tid", "mono",
+                                 "dur_ms")}
+            args["span_id"] = begin.get("span")
+            return args
+
+        for idx, ev in enumerate(events):
+            kind = ev.get("kind")
+            if kind == "clock_anchor" and "wall" in ev and "mono" in ev:
+                offset = float(ev["wall"]) - float(ev["mono"])
+            elif kind == "span_begin" and "span" in ev:
+                # remember the stream index: record() appends under one
+                # lock, so file order IS true chronological order within
+                # a rank — the only tiebreak that can never invert a
+                # span's own B/E pair on a µs ts tie (depth-based keys
+                # sorted a zero-width nested span's E before its B)
+                ev["_idx"] = idx
+                open_spans[ev["span"]] = ev
+            elif kind == "span_end" and ev.get("span") in open_spans:
+                # paired form -> B/E pair, each carrying its source
+                # record's stream index so the stable ts sort below
+                # reconstructs enter/exit order exactly on ties
+                begin = open_spans.pop(ev["span"])
+                begin_idx = begin.pop("_idx", idx)
+                tid = tids.setdefault(begin.get("tid"), len(tids))
+                ts0 = (float(begin["mono"]) + offset) * 1e6
+                ts1 = (float(ev["mono"]) + offset) * 1e6
+                trace.append({"ph": "B", "name": begin.get("name", "?"),
+                              "pid": rank_id, "tid": tid,
+                              "ts": ts0, "args": span_args(begin),
+                              "_sub": begin_idx})
+                trace.append({"ph": "E", "name": begin.get("name", "?"),
+                              "pid": rank_id, "tid": tid,
+                              "ts": max(ts1, ts0), "_sub": idx})
+            elif kind == "span" and "mono" in ev:
+                # complete form -> ph "X" (ts + dur).  These are written
+                # at EXIT, so their file order is child-before-parent; a
+                # synthesized B/E pair could land child-B-before-parent-B
+                # on a µs tie and unbalance the track.  X events carry
+                # their extent and cannot be imbalanced; Perfetto nests
+                # them natively.
+                tid = tids.setdefault(ev.get("tid"), len(tids))
+                trace.append({"ph": "X", "name": ev.get("name", "?"),
+                              "pid": rank_id, "tid": tid,
+                              "ts": (float(ev["mono"]) + offset) * 1e6,
+                              "dur": max(float(ev.get("dur_ms", 0.0))
+                                         * 1e3, 0.001),
+                              "args": span_args(ev),
+                              "_sub": idx})
+            elif kind == "collective":
+                op = str(ev.get("op", "collective"))
+                occ = n_coll.get(op, 0)
+                n_coll[op] = occ + 1
+                tid = tids.setdefault(None, len(tids))
+                dur = max(float(ev.get("wall_ms", 0.0)) * 1e3, 1.0)
+                # record_collective stamps the event AFTER the op, so its
+                # wall stamp is the END; the slice starts wall_ms earlier
+                ts = (float(ev.get("t", 0.0))
+                      - float(ev.get("wall_ms", 0.0)) / 1e3) * 1e6
+                trace.append({"ph": "X", "name": op, "pid": rank_id,
+                              "tid": tid, "ts": ts, "dur": dur,
+                              "args": {"nbytes": ev.get("nbytes"),
+                                       "traced": ev.get("traced")}})
+                # flow: the occ-th <op> on every rank is the same logical
+                # collective; chain the ranks with one flow id
+                flow_id = hash((op, occ)) & 0x7FFFFFFF
+                first = coll_occurrence.setdefault((op, occ), rank_id)
+                ph = "s" if first == rank_id else "t"
+                trace.append({"ph": ph, "cat": "collective", "name": op,
+                              "id": flow_id, "pid": rank_id, "tid": tid,
+                              "ts": ts + dur / 2, "bp": "e"})
+    if not any_events:
+        return None
+    # chronological, with the _sub stream-index key breaking µs ts ties
+    # (per-rank file order is true chronological order, so B/E nesting
+    # and each pair's own B-before-E survive zero-width spans)
+    meta = [e for e in trace if e["ph"] == "M"]
+    rest = sorted((e for e in trace if e["ph"] != "M"),
+                  key=lambda e: (e["ts"], e.get("_sub", 0)))
+    if rest:
+        t0 = min(e["ts"] for e in rest)
+        for e in rest:
+            e["ts"] = round(e["ts"] - t0, 3)
+            e.pop("_sub", None)
+    out = out or os.path.join(directory, "trace.json")
+    # the supervisor's post-mortem re-export may target a directory no
+    # rank ever created (SIGKILLed gang -> no atexit export ran)
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    payload = {"traceEvents": meta + rest, "displayTimeUnit": "ms"}
+    tmp = f"{out}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, out)
+    return out
+
+
+def _prom_escape(value: str) -> str:
+    return str(value).replace("\\", r"\\").replace('"', r'\"')
+
+
+def export_prometheus(path: Optional[str] = None) -> Optional[str]:
+    """Write an OpenMetrics text snapshot of this process's ``summary()``
+    rollups to ``path`` (default ``<telemetry dir>/metrics-<rank>.prom``)
+    and return the path — the production-scrape surface: point a node
+    exporter textfile collector (or any OpenMetrics scraper) at it to get
+    step rate, block-wait, collective bytes, retrace count, and heartbeat
+    age without touching the JSONL streams."""
+    if path is None:
+        if not _state.dir:
+            return None
+        path = os.path.join(_state.dir, f"metrics-{_state.rank}.prom")
+    s = summary()
+    rank_lbl = f'rank="{s["rank"]}"'
+    lines: List[str] = []
+
+    def gauge(name, value, labels="", kind="gauge"):
+        lines.append(f"# TYPE {name} {kind}")
+        lbl = f"{{{rank_lbl}{',' if labels else ''}{labels}}}"
+        lines.append(f"{name}{lbl} {value}")
+
+    def per_key(name, rows, field, label_key, kind="counter", scale=1):
+        lines.append(f"# TYPE {name} {kind}")
+        for key, row in sorted(rows.items()):
+            v = row[field] * scale if scale != 1 else row[field]
+            lines.append(
+                f'{name}{{{rank_lbl},{label_key}="{_prom_escape(key)}"}} '
+                f"{v}")
+
+    steps = s["steps"]
+    per_key("mx_step_total", steps, "count", "executor")
+    per_key("mx_step_compile_total", steps, "compile_count", "executor")
+    per_key("mx_step_compile_ms_total", steps, "compile_ms", "executor")
+    per_key("mx_step_exec_ms_total", steps, "exec_ms", "executor")
+    per_key("mx_step_block_wait_ms_total", steps, "block_wait_ms",
+            "executor")
+    per_key("mx_step_transfer_bytes_total", steps, "transfer_bytes",
+            "executor")
+    lines.append("# TYPE mx_step_samples_per_sec gauge")
+    for key, row in sorted(steps.items()):
+        if "samples_per_sec" in row:
+            lines.append(
+                f'mx_step_samples_per_sec{{{rank_lbl},'
+                f'executor="{_prom_escape(key)}"}} '
+                f'{row["samples_per_sec"]}')
+    c = s["collectives"]
+    gauge("mx_collective_total", c["count"], kind="counter")
+    gauge("mx_collective_bytes_total", c["bytes"], kind="counter")
+    gauge("mx_collective_ms_total", c["total_ms"], kind="counter")
+    if c["total_ms"] > 0:
+        gauge("mx_collective_bytes_per_sec",
+              round(c["bytes"] / (c["total_ms"] / 1e3), 1))
+    ck = s["checkpoints"]
+    gauge("mx_checkpoint_saves_total", ck["saves"], kind="counter")
+    gauge("mx_checkpoint_save_ms_total", ck["save_ms"], kind="counter")
+    gauge("mx_checkpoint_loads_total", ck["loads"], kind="counter")
+    gauge("mx_checkpoint_fallbacks_total", ck["fallbacks"], kind="counter")
+    per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
+    per_key("mx_span_ms_total", s["spans"], "total_ms", "span",
+            kind="counter")
+    per_key("mx_span_max_ms", s["spans"], "max_ms", "span", kind="gauge")
+    lines.append("# TYPE mx_retrace_signatures gauge")
+    for key, row in sorted(s["retraces"].items()):
+        lines.append(
+            f'mx_retrace_signatures{{{rank_lbl},'
+            f'executor="{_prom_escape(key)}"}} {row["traces"]}')
+    if _state.hb_wall:
+        gauge("mx_heartbeat_age_seconds",
+              round(max(0.0, time.time() - _state.hb_wall), 3))
+    gauge("mx_restart_count", s["restart_count"])
+    lines.append("# EOF")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)  # scrapers never see a torn snapshot
+    return path
+
+
+def _trace_export_target() -> Optional[str]:
+    """MX_TRACE_EXPORT: unset/0/false = off (the default — exporting reads
+    back every rank's stream, not something to pay unasked); 1/true =
+    export into MX_TELEMETRY_DIR; any other value = target directory."""
+    raw = os.environ.get("MX_TRACE_EXPORT", "").strip()
+    if not raw or raw.lower() in ("0", "false", "off"):
+        return None
+    if raw.lower() in ("1", "true", "on"):
+        return _state.dir
+    return raw
+
+
+def _export_at_exit() -> None:
+    """Best-effort per-process export.  Rank 0's merge here can race peer
+    ranks that are still running (their final flush lands after the
+    read); under tools/launch.py the supervisor re-runs the merge after
+    every rank is reaped and overwrites this trace.json with the
+    authoritative one.  Unsupervised single-rank runs have no race."""
+    target = _trace_export_target()
+    if not target or not _state.dir:
+        return
+    try:
+        os.makedirs(target, exist_ok=True)
+        export_prometheus(
+            os.path.join(target, f"metrics-{_state.rank}.prom"))
+        # every rank snapshots its own metrics; only rank 0 merges the
+        # gang trace (all ranks racing one trace.json would tear it)
+        if _state.rank == 0:
+            export_chrome_trace(_state.dir,
+                                out=os.path.join(target, "trace.json"))
+    except Exception as e:  # export must never turn a clean exit dirty
+        _LOG.warning("MX_TRACE_EXPORT failed: %s", e)
+
+
+# LIFO atexit: this runs BEFORE the flush registered above, so
+# _export_at_exit's own flush() call covers the final pending events
+atexit.register(_export_at_exit)
 
 
 # attach the sink at import when the launcher/user exported the env

@@ -1,0 +1,68 @@
+"""Trace-analysis-under-gang worker (docs/OBSERVABILITY.md §Tracing &
+analysis acceptance shape): 2 ranks drive a dp2 global mesh through
+DataParallelStep in synchronous mode (MX_ASYNC_INFLIGHT=0, every step
+forced inline so host waits land in recorded ``loss_wait`` spans) with a
+per-step explicit loss allreduce (collective events for the bandwidth
+table).  When ``TRACE_STRAGGLER_RANK`` names this rank it sleeps
+``TRACE_STRAGGLER_SLEEP`` seconds of UNINSTRUMENTED host time per step —
+the injected straggler.  In lock-step sync training that sleep shows up
+on the peers as recorded waiting and on the straggler as unaccounted
+wall, which is exactly the idle-gap signature tools/trace_report.py
+flags."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# one CPU device per process (a dp2 global mesh) BEFORE jax initializes:
+# the pytest parent's XLA_FLAGS asks for 8 virtual devices per host,
+# which a batch of 8 over 2 processes cannot shard
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+os.environ["MX_ASYNC_INFLIGHT"] = "0"  # sync: waits land in loss_wait
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402  (rendezvous runs at import)
+from mxnet_tpu import gluon, nd, telemetry
+from mxnet_tpu.parallel import DataParallelStep, make_mesh
+from mxnet_tpu.parallel import dist
+
+
+def main():
+    import jax
+
+    assert telemetry.enabled(), "MX_TELEMETRY_DIR must be set"
+    n = jax.process_count()
+    rank = jax.process_index()
+    assert n == 2, n
+    straggler = int(os.environ.get("TRACE_STRAGGLER_RANK", "-1"))
+    sleep_s = float(os.environ.get("TRACE_STRAGGLER_SLEEP", "0.05"))
+    steps = int(os.environ.get("TRACE_STEPS", "25"))
+
+    mesh = make_mesh(devices=jax.devices())
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Normal(0.5))
+    step = DataParallelStep(net, gluon.loss.L2Loss(), mesh=mesh,
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05})
+    rng = np.random.RandomState(0)  # same global batch on every rank (SPMD)
+    val = float("nan")
+    for _i in range(steps):
+        x = nd.array(rng.rand(8, 4).astype(np.float32))
+        y = nd.array(rng.rand(8, 4).astype(np.float32))
+        loss = float(step.step(x, y))  # forced inline (sync mode)
+        # explicit gang loss averaging: one recorded collective per step
+        with telemetry.span("loss_allreduce", paired=True):
+            summed = dist.allreduce_sum(np.float32(loss))
+            val = float(np.asarray(summed)) / n
+        if rank == straggler:
+            time.sleep(sleep_s)  # uninstrumented host time: the straggler
+    telemetry.flush()
+    print(f"worker {rank}/{n}: trace OK mean_loss={val:.5f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

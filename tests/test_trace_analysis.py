@@ -1,0 +1,559 @@
+"""Gang-wide trace analysis (docs/OBSERVABILITY.md §Tracing & analysis):
+span API + kill switch, clock anchors, Chrome/Perfetto + Prometheus
+exporters, the tools/trace_report.py straggler-hunting CLI, the
+launch.py span-collapsed flight tail, and the spans-don't-perturb-
+training parity guarantee."""
+import importlib.util
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TRACE_REPORT = os.path.join(_REPO, "tools", "trace_report.py")
+
+
+@pytest.fixture
+def tele():
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+
+
+def _events(tmp_path, rank=0):
+    return [json.loads(line)
+            for line in open(telemetry.event_path(str(tmp_path), rank))]
+
+
+# ---------------------------------------------------------------------------
+# span API
+# ---------------------------------------------------------------------------
+def test_span_complete_event_and_nesting(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    with tele.span("outer", executor="X"):
+        with tele.span("inner"):
+            time.sleep(0.002)
+    tele.flush()
+    spans = [e for e in _events(tmp_path) if e["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["inner", "outer"]  # exit order
+    inner, outer = spans
+    assert inner["parent"] == outer["span"] and inner["depth"] == 1
+    assert outer["parent"] == 0 and outer["depth"] == 0
+    assert outer["executor"] == "X"
+    assert inner["dur_ms"] >= 2.0
+    assert outer["dur_ms"] >= inner["dur_ms"]
+    assert inner["mono"] >= outer["mono"]
+    s = tele.summary()["spans"]
+    assert s["outer"]["count"] == 1 and s["inner"]["count"] == 1
+    assert s["outer"]["total_ms"] >= s["inner"]["total_ms"]
+
+
+def test_span_paired_emits_begin_end(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    with tele.span("blocking", paired=True, step=7):
+        pass
+    tele.flush()
+    evs = _events(tmp_path)
+    begin = [e for e in evs if e["kind"] == "span_begin"]
+    end = [e for e in evs if e["kind"] == "span_end"]
+    assert len(begin) == 1 and len(end) == 1
+    assert begin[0]["span"] == end[0]["span"]
+    assert begin[0]["step"] == 7 and begin[0]["name"] == "blocking"
+    assert end[0]["dur_ms"] >= 0
+
+
+def test_span_error_annotated(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    with pytest.raises(ValueError):
+        with tele.span("doomed"):
+            raise ValueError("boom")
+    tele.flush()
+    sp = [e for e in _events(tmp_path) if e["kind"] == "span"][0]
+    assert sp["error"] == "ValueError"
+
+
+def test_record_span_retroactive(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    with tele.span("outer"):
+        tele.record_span("waited", 1.0, 1.25, executor="X")
+    tele.flush()
+    spans = [e for e in _events(tmp_path) if e["kind"] == "span"]
+    waited = [s for s in spans if s["name"] == "waited"][0]
+    assert waited["dur_ms"] == pytest.approx(250.0)
+    assert waited["depth"] == 1  # nested under the open outer span
+    assert waited["parent"] == [s for s in spans
+                                if s["name"] == "outer"][0]["span"]
+
+
+def test_span_kill_switch(tele, tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_TELEMETRY_SPANS", "0")
+    tele.enable(str(tmp_path))
+    assert not tele.spans_enabled()
+    with tele.span("invisible"):
+        pass
+    tele.record_span("also_invisible", 0.0, 1.0)
+    tele.flush()
+    kinds = {e["kind"] for e in _events(tmp_path)}
+    assert not kinds & {"span", "span_begin", "span_end"}
+    assert tele.summary()["spans"] == {}
+    # step events and heartbeats keep flowing with spans off
+    tele.record_step("X", step=1, wall_s=0.01)
+    tele.flush()
+    assert "step" in {e["kind"] for e in _events(tmp_path)}
+
+
+def test_spans_disabled_entirely_without_recorder(tele):
+    assert not tele.spans_enabled()
+    with tele.span("noop"):  # must not raise or allocate state
+        pass
+    assert tele.summary()["spans"] == {}
+
+
+# ---------------------------------------------------------------------------
+# clock anchors
+# ---------------------------------------------------------------------------
+def test_clock_anchor_at_enable_and_every_flush(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    tele.record("x")
+    tele.flush()
+    tele.record("y")
+    tele.flush()
+    anchors = [e for e in _events(tmp_path) if e["kind"] == "clock_anchor"]
+    assert len(anchors) >= 3  # one at enable + one per flush batch
+    for a in anchors:
+        assert {"wall", "mono"} <= set(a)
+        # the pair is taken at one instant: wall ~ t
+        assert abs(a["wall"] - a["t"]) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+def _write_synthetic_rank(directory, rank, wall_ms=2.0, n=10,
+                          spacing=None, anchor=True, collective=True):
+    """A synthetic rank stream: nested spans + steps (+ collectives),
+    using the same schema telemetry.py writes — the no-jax fixture.
+    Default spacing models a tight compute-bound loop (each step starts
+    just after the previous one's wall), the shape where step-wall skew
+    is the straggler signal."""
+    if spacing is None:
+        spacing = wall_ms / 1e3 + 0.003
+    t0, mono0 = 1000.0 + rank * 7.5, 5.0  # rank start-time skew
+    lines = []
+    if anchor:
+        lines.append({"t": t0, "kind": "clock_anchor", "rank": rank,
+                      "wall": t0, "mono": mono0})
+    sid = rank * 10000
+    for i in range(n):
+        t = t0 + i * spacing
+        mono = mono0 + i * spacing
+        sid += 1
+        outer = sid
+        lines.append({"t": t, "kind": "span_begin", "rank": rank,
+                      "name": "train_step", "span": outer, "parent": 0,
+                      "depth": 0, "tid": 7, "mono": mono})
+        sid += 1
+        lines.append({"t": t, "kind": "span", "rank": rank,
+                      "name": "dispatch", "span": sid, "parent": outer,
+                      "depth": 1, "tid": 7,
+                      "mono": mono + 0.0002, "dur_ms": wall_ms / 2})
+        lines.append({"t": t + wall_ms / 1e3, "kind": "span_end",
+                      "rank": rank, "name": "train_step", "span": outer,
+                      "tid": 7, "mono": mono + wall_ms / 1e3,
+                      "dur_ms": wall_ms})
+        lines.append({"t": t, "kind": "step", "rank": rank,
+                      "executor": "X", "step": i + 1, "wall_ms": wall_ms,
+                      "traced": i == 0, "samples": 8,
+                      "transfer_bytes": 128})
+        if collective:
+            lines.append({"t": t, "kind": "collective", "rank": rank,
+                          "op": "global_allreduce", "nbytes": 4096,
+                          "wall_ms": 0.5, "traced": i == 0})
+    with open(os.path.join(str(directory), f"rank-{rank}.jsonl"),
+              "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+def _validate_chrome(trace_events):
+    """Trace-event schema: chronological per track, matched B/E pairs."""
+    stacks = {}
+    last_ts = {}
+    for e in trace_events:
+        if e["ph"] == "M":
+            continue
+        key = (e["pid"], e.get("tid"))
+        assert e["ts"] >= last_ts.get(key, 0.0), e
+        last_ts[key] = e["ts"]
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks.get(key), f"E without B: {e}"
+            assert stacks[key].pop() == e["name"], e
+    open_spans = {k: v for k, v in stacks.items() if v}
+    assert not open_spans, open_spans
+
+
+def test_chrome_trace_two_rank_merge(tele, tmp_path):
+    _write_synthetic_rank(tmp_path, 0)
+    _write_synthetic_rank(tmp_path, 1)
+    out = telemetry.export_chrome_trace(str(tmp_path))
+    payload = json.load(open(out))
+    evs = payload["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}
+    # named process track per rank
+    names = {e["pid"]: e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    _validate_chrome(evs)
+    # paired spans became B/E; complete-form spans became X slices
+    b_names = [e["name"] for e in evs if e["ph"] == "B" and e["pid"] == 0]
+    assert "train_step" in b_names
+    x_names = {e["name"] for e in evs if e["ph"] == "X" and e["pid"] == 0}
+    assert "dispatch" in x_names
+    # a nested dispatch X sits inside its train_step B/E extent
+    b0 = min(e["ts"] for e in evs
+             if e["ph"] == "B" and e["pid"] == 0
+             and e["name"] == "train_step")
+    d0 = min(e["ts"] for e in evs
+             if e["ph"] == "X" and e["pid"] == 0
+             and e["name"] == "dispatch")
+    assert d0 >= b0
+    # collectives became complete events + flow events chaining the ranks
+    xs = [e for e in evs if e["ph"] == "X"
+          and e["name"] == "global_allreduce"]
+    assert xs and all(e["dur"] > 0 for e in xs)
+    flows = [e for e in evs if e["ph"] in ("s", "t")]
+    assert {e["ph"] for e in flows} == {"s", "t"}  # start + pass-through
+    # the same occurrence shares one flow id across ranks
+    ids0 = [e["id"] for e in flows if e["pid"] == 0]
+    ids1 = [e["id"] for e in flows if e["pid"] == 1]
+    assert set(ids0) == set(ids1)
+    # clock anchors aligned the rank start-time skew: rank 1's first
+    # train_step B sits ~7.5s (the synthetic skew) after rank 0's
+    first = {pid: min(e["ts"] for e in evs
+                      if e["ph"] == "B" and e["pid"] == pid)
+             for pid in (0, 1)}
+    assert first[1] - first[0] == pytest.approx(7.5e6, rel=0.01)
+
+
+def test_chrome_trace_empty_dir_returns_none(tele, tmp_path):
+    assert telemetry.export_chrome_trace(str(tmp_path)) is None
+
+
+def test_prometheus_snapshot_parses(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    tele.record_step("Exec\"A", step=1, wall_s=0.5, samples=0, traced=True)
+    tele.record_step("Exec\"A", step=2, wall_s=0.1, samples=16)
+    tele.record_collective("device_allreduce", nbytes=1024, wall_s=0.002)
+    tele.record_checkpoint("save", step=2, wall_s=0.05, nbytes=4096)
+    with tele.span("train_step"):
+        pass
+    tele.heartbeat(2, force=True)
+    path = tele.export_prometheus(str(tmp_path / "metrics.prom"))
+    lines = open(path).read().splitlines()
+    assert lines[-1] == "# EOF"
+    sample_re = re.compile(
+        r'^[a-z_][a-z0-9_]*\{[^{}]*\} -?[0-9.eE+-]+$')
+    for line in lines[:-1]:
+        assert line.startswith("# TYPE ") or sample_re.match(line), line
+    text = "\n".join(lines)
+    assert 'mx_step_total{rank="0",executor="Exec\\"A"} 2' in text
+    assert 'mx_collective_bytes_total{rank="0"} 1024' in text
+    assert 'mx_span_total{rank="0",span="train_step"} 1' in text
+    assert "mx_heartbeat_age_seconds" in text
+    assert 'mx_checkpoint_saves_total{rank="0"} 1' in text
+
+
+def test_trace_export_env_off_by_default(tele, tmp_path, monkeypatch):
+    monkeypatch.delenv("MX_TRACE_EXPORT", raising=False)
+    assert telemetry._trace_export_target() is None
+    monkeypatch.setenv("MX_TRACE_EXPORT", "0")
+    assert telemetry._trace_export_target() is None
+    tele.enable(str(tmp_path))
+    monkeypatch.setenv("MX_TRACE_EXPORT", "1")
+    assert telemetry._trace_export_target() == str(tmp_path)
+    monkeypatch.setenv("MX_TRACE_EXPORT", str(tmp_path / "out"))
+    assert telemetry._trace_export_target() == str(tmp_path / "out")
+
+
+def test_trace_export_at_exit_hook(tele, tmp_path, monkeypatch):
+    tele.enable(str(tmp_path))
+    tele.record_step("X", step=1, wall_s=0.01)
+    monkeypatch.setenv("MX_TRACE_EXPORT", str(tmp_path / "export"))
+    telemetry._export_at_exit()
+    assert (tmp_path / "export" / "metrics-0.prom").exists()
+    assert (tmp_path / "export" / "trace.json").exists()  # rank 0 merges
+
+
+# ---------------------------------------------------------------------------
+# trace_report.py CLI
+# ---------------------------------------------------------------------------
+def _report(directory, *args):
+    return subprocess.run(
+        [sys.executable, _TRACE_REPORT, str(directory), *args],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_trace_report_clean_run_exits_zero(tmp_path):
+    _write_synthetic_rank(tmp_path, 0, wall_ms=2.0)
+    _write_synthetic_rank(tmp_path, 1, wall_ms=2.1)
+    res = _report(tmp_path)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "no anomalies detected" in res.stdout
+    assert "collective bandwidth" in res.stdout
+    assert "global_allreduce" in res.stdout
+
+
+def test_trace_report_flags_step_wall_straggler(tmp_path):
+    _write_synthetic_rank(tmp_path, 0, wall_ms=2.0)
+    _write_synthetic_rank(tmp_path, 1, wall_ms=20.0)  # 10x slower
+    res = _report(tmp_path, "--json")
+    assert res.returncode == 3, (res.stdout, res.stderr)
+    rep = json.loads(res.stdout)
+    assert [s["rank"] for s in rep["stragglers"]] == [1]
+    assert rep["stragglers"][0]["rule"] == "step-wall"
+    assert rep["per_rank"]["0"]["window_mean_ms"] == pytest.approx(2.0)
+    assert rep["per_rank"]["1"]["window_mean_ms"] == pytest.approx(20.0)
+    assert rep["anomalies"]
+
+
+def test_trace_report_flags_idle_gap_straggler(tmp_path):
+    """The lock-step shape: equal step walls and cadence, but one rank's
+    inter-step time is UNRECORDED host work while the peer's equal share
+    of waiting sits in recorded loss_wait spans."""
+    for rank, recorded in ((0, True), (1, False)):
+        t0, mono0 = 1000.0, 5.0
+        lines = [{"t": t0, "kind": "clock_anchor", "rank": rank,
+                  "wall": t0, "mono": mono0}]
+        sid = rank * 10000
+        t, mono = t0, mono0
+        for i in range(20):
+            sid += 1
+            lines.append({"t": t, "kind": "span", "rank": rank,
+                          "name": "train_step", "span": sid, "parent": 0,
+                          "depth": 0, "tid": 7, "mono": mono,
+                          "dur_ms": 2.0})
+            lines.append({"t": t, "kind": "step", "rank": rank,
+                          "executor": "X", "step": i + 1, "wall_ms": 2.0,
+                          "traced": False})
+            t += 0.002
+            mono += 0.002
+            if recorded:  # peer: waits for the straggler, recorded
+                sid += 1
+                lines.append({"t": t, "kind": "span", "rank": rank,
+                              "name": "loss_wait", "span": sid,
+                              "parent": 0, "depth": 0, "tid": 7,
+                              "mono": mono, "dur_ms": 50.0})
+            t += 0.05
+            mono += 0.05
+        with open(tmp_path / f"rank-{rank}.jsonl", "w") as f:
+            for line in lines:
+                f.write(json.dumps(line) + "\n")
+    res = _report(tmp_path, "--json")
+    assert res.returncode == 3, (res.stdout, res.stderr)
+    rep = json.loads(res.stdout)
+    assert [s["rank"] for s in rep["stragglers"]] == [1]
+    assert rep["stragglers"][0]["rule"] == "idle-gap"
+
+
+def test_trace_report_warns_on_missing_anchor(tmp_path):
+    _write_synthetic_rank(tmp_path, 0, anchor=False)
+    res = _report(tmp_path)
+    assert "no clock_anchor" in res.stdout, res.stdout
+
+
+def test_trace_report_flags_event_gap_and_retrace(tmp_path):
+    _write_synthetic_rank(tmp_path, 0)
+    with open(tmp_path / "rank-0.jsonl", "a") as f:
+        f.write(json.dumps({"t": 2000.0, "kind": "retrace", "rank": 0,
+                            "executor": "X", "traces": 9,
+                            "signature": "((7, 3), float32)"}) + "\n")
+    res = _report(tmp_path, "--json", "--heartbeat-gap", "30")
+    assert res.returncode == 3
+    rep = json.loads(res.stdout)
+    rules = {a.split(":")[0] for a in rep["anomalies"]}
+    assert "retrace storm" in rules
+    assert "event gap" in rules  # the 2000.0 stamp is ~1000s after t0
+    assert rep["event_gaps"][0]["rank"] == 0
+
+
+def test_trace_report_empty_dir_exits_two(tmp_path):
+    res = _report(tmp_path)
+    assert res.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# launch.py flight-tail span rendering
+# ---------------------------------------------------------------------------
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "launch_for_test", os.path.join(_REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flight_tail_collapses_span_pairs(tmp_path):
+    launch = _load_launch()
+    lines = [
+        {"t": 1.0, "kind": "clock_anchor", "rank": 0, "wall": 1.0,
+         "mono": 0.0},
+        {"t": 1.0, "kind": "step", "rank": 0, "step": 1, "wall_ms": 5.0},
+        {"t": 1.1, "kind": "span_begin", "rank": 0, "name": "loss_wait",
+         "span": 7, "parent": 0, "depth": 0, "tid": 9, "mono": 0.1,
+         "executor": "X"},
+        {"t": 1.2, "kind": "span_end", "rank": 0, "name": "loss_wait",
+         "span": 7, "tid": 9, "mono": 0.2, "dur_ms": 100.0},
+        {"t": 1.3, "kind": "span", "rank": 0, "name": "train_step",
+         "span": 8, "parent": 0, "depth": 0, "tid": 9, "mono": 0.3,
+         "dur_ms": 12.5, "executor": "X"},
+        # still-open begin: the "died inside X" clue must survive as-is
+        {"t": 1.4, "kind": "span_begin", "rank": 0,
+         "name": "bucket_collective", "span": 9, "parent": 0, "depth": 0,
+         "tid": 9, "mono": 0.4},
+    ]
+    with open(tmp_path / "rank-0.jsonl", "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+    tail = launch._flight_tail(str(tmp_path), 0)
+    evs = [json.loads(t) for t in tail]
+    kinds = [e["kind"] for e in evs]
+    # anchor dropped; pair collapsed to one "span" line with duration;
+    # complete span stripped of plumbing; open begin kept verbatim
+    assert kinds == ["step", "span", "span", "span_begin"], kinds
+    assert evs[1]["name"] == "loss_wait" and evs[1]["dur_ms"] == 100.0
+    assert evs[1]["executor"] == "X"
+    assert "span" not in evs[2] and evs[2]["dur_ms"] == 12.5
+    assert evs[3]["name"] == "bucket_collective"
+
+
+def test_launch_reexports_authoritative_trace(tmp_path, monkeypatch):
+    """With MX_TRACE_EXPORT on, the supervisor re-merges the gang trace
+    after every rank is reaped: rank 0's own atexit merge can race peers
+    still running and drop the straggler tail, so the supervisor's merge
+    over the complete files must overwrite it."""
+    launch = _load_launch()
+    _write_synthetic_rank(tmp_path, 0)
+    _write_synthetic_rank(tmp_path, 1)
+    out = tmp_path / "trace.json"
+    # rank 0's racy best-effort export: stale, missing rank 1 entirely
+    out.write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 0, "ts": 0,
+         "args": {"name": "rank 0"}}]}))
+    monkeypatch.setenv("MX_TRACE_EXPORT", "1")
+    launch._reexport_trace(str(tmp_path))
+    evs = json.load(open(out))["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}  # rank 1 restored
+    _validate_chrome(evs)
+    # kill switch: no target -> no child run, file untouched
+    out.write_text("sentinel")
+    monkeypatch.delenv("MX_TRACE_EXPORT")
+    launch._reexport_trace(str(tmp_path))
+    assert out.read_text() == "sentinel"
+
+
+# ---------------------------------------------------------------------------
+# spans must not perturb the computation
+# ---------------------------------------------------------------------------
+def _train_losses_and_weights(tmp_path, tag):
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    telemetry.reset()
+    telemetry.enable(str(tmp_path / tag))
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    step = DataParallelStep(net, gluon.loss.L2Loss(), mesh=local_mesh(),
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(5):
+        x = nd.array(rng.rand(8, 4).astype(np.float32))
+        y = nd.array(rng.rand(8, 4).astype(np.float32))
+        losses.append(float(step.step(x, y)))
+    step.sync_to_block()
+    weights = [p.data().asnumpy().copy()
+               for p in net.collect_params().values()]
+    return losses, weights
+
+
+def test_spans_do_not_perturb_training(tele, tmp_path, monkeypatch):
+    """Acceptance: losses/weights bitwise unchanged with spans enabled vs
+    MX_TELEMETRY_SPANS=0 — observability must observe, not perturb."""
+    monkeypatch.setenv("MX_TELEMETRY_SPANS", "1")
+    on_losses, on_weights = _train_losses_and_weights(tmp_path, "on")
+    # the span layer actually recorded in mode one
+    assert telemetry.summary()["spans"]
+    monkeypatch.setenv("MX_TELEMETRY_SPANS", "0")
+    off_losses, off_weights = _train_losses_and_weights(tmp_path, "off")
+    assert telemetry.summary()["spans"] == {}
+    assert on_losses == off_losses  # float equality = bitwise for scalars
+    for a, b in zip(on_weights, off_weights):
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# real 2-rank gang: trace report + chrome export (acceptance shape)
+# ---------------------------------------------------------------------------
+@pytest.mark.dist
+@pytest.mark.slow
+def test_gang_trace_report_flags_injected_straggler(tmp_path):
+    """Launch a real 2-rank gang with rank 1 sleep-instrumented as the
+    straggler, then: trace_report flags it (nonzero exit), reports
+    per-rank skew and collective bandwidth, and the exported Chrome trace
+    validates (chronological, matched B/E per track)."""
+    tdir = tmp_path / "telemetry"
+    env = dict(os.environ, MX_TELEMETRY_DIR=str(tdir),
+               MX_TELEMETRY_FLUSH_SEC="0.2", MX_HEARTBEAT_SEC="0.5",
+               TRACE_STRAGGLER_RANK="1", TRACE_STRAGGLER_SLEEP="0.06",
+               MX_TRACE_STRAGGLER_PCT="25")
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", "2", "--force-cpu", "--",
+           sys.executable,
+           os.path.join(_REPO, "tests", "dist", "trace_worker.py")]
+    res = subprocess.run(cmd, cwd=_REPO, timeout=240, capture_output=True,
+                         text=True, env=env)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("trace OK") == 2, res.stdout
+    # --- trace_report: straggler flagged, skew + bandwidth reported
+    rep_res = subprocess.run(
+        [sys.executable, _TRACE_REPORT, str(tdir), "--json"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert rep_res.returncode == 3, (rep_res.stdout, rep_res.stderr)
+    rep = json.loads(rep_res.stdout)
+    assert 1 in [s["rank"] for s in rep["stragglers"]], rep["stragglers"]
+    assert 0 not in [s["rank"] for s in rep["stragglers"]]
+    assert rep["per_rank"]["0"]["window_mean_ms"] is not None
+    assert rep["per_rank"]["1"]["window_mean_ms"] is not None
+    colls = [row for row in rep["collectives"]
+             if row["op"] == "global_allreduce"]
+    assert {row["rank"] for row in colls} == {0, 1}
+    assert all(row["bytes"] > 0 for row in colls)
+    # the straggler's unaccounted time towers over the peer's
+    assert (rep["per_rank"]["1"]["idle_gap_ms"]
+            > rep["per_rank"]["0"]["idle_gap_ms"] + 500)
+    # --- human-readable rendering names the straggler too
+    txt_res = subprocess.run([sys.executable, _TRACE_REPORT, str(tdir)],
+                             env=env, capture_output=True, text=True,
+                             timeout=60)
+    assert txt_res.returncode == 3
+    assert "ANOMALIES" in txt_res.stdout
+    # --- chrome trace for the same run validates against the schema
+    out = telemetry.export_chrome_trace(str(tdir))
+    payload = json.load(open(out))
+    evs = payload["traceEvents"]
+    assert {e["pid"] for e in evs} >= {0, 1}
+    _validate_chrome(evs)
+    span_names = {e["name"] for e in evs if e["ph"] in ("B", "X")}
+    assert {"train_step", "dispatch", "loss_wait",
+            "loss_allreduce"} <= span_names, span_names

@@ -65,13 +65,138 @@ def _tee(stream, sink, prefix: str) -> None:
 # telemetry.heartbeat_path)
 # ---------------------------------------------------------------------------
 def _flight_tail(tdir: str, rank: int, k: int = FLIGHT_TAIL_EVENTS):
-    """Last k JSONL event lines of a rank's telemetry stream."""
+    """Last k events of a rank's telemetry stream, rendered for humans:
+    span begin/end pairs collapse into ONE ``"kind": "span"`` line
+    carrying the duration (the raw pair would burn two slots of an
+    8-event tail on one fact), clock_anchor bookkeeping lines are
+    dropped, and an unmatched span_begin survives as-is — an OPEN span in
+    a dead rank's tail is exactly the "died inside X" post-mortem clue.
+    A span_end whose begin scrolled off the raw window renders as a
+    collapsed span line by itself (the end alone carries name + dur_ms).
+    Non-span lines pass through verbatim."""
     path = os.path.join(tdir, f"rank-{rank}.jsonl")
     try:
         with open(path, errors="replace") as f:
-            return [line.rstrip("\n") for line in deque(f, maxlen=k)]
+            # read enough raw lines that k survives the collapsing
+            raw = [line.rstrip("\n") for line in deque(f, maxlen=8 * k)]
     except OSError:
         return []
+    rendered = []  # (span id or None, text line)
+    begins = {}    # span id -> index into rendered (pending span_begin)
+    for line in raw:
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            rendered.append((None, line))
+            continue
+        if not isinstance(ev, dict):
+            rendered.append((None, line))
+            continue
+        kind = ev.get("kind")
+        if kind == "clock_anchor":
+            continue
+        if kind == "span":
+            # complete hot-path span: strip the merge-key plumbing so the
+            # 8-event tail spends its width on the facts
+            merged = {k: v for k, v in ev.items()
+                      if k not in ("span", "parent", "depth", "tid",
+                                   "mono")}
+            rendered.append((None, json.dumps(merged)))
+        elif kind == "span_begin" and "span" in ev:
+            begins[ev["span"]] = len(rendered)
+            rendered.append((ev["span"], line))
+        elif kind == "span_end" and ev.get("span") in begins:
+            idx = begins.pop(ev["span"])
+            begin_ev = json.loads(rendered[idx][1])
+            merged = {"t": begin_ev.get("t"), "kind": "span",
+                      "rank": ev.get("rank"), "name": ev.get("name"),
+                      "dur_ms": ev.get("dur_ms")}
+            merged.update({kk: vv for kk, vv in begin_ev.items()
+                           if kk not in ("t", "kind", "rank", "name",
+                                         "span", "parent", "depth", "tid",
+                                         "mono")})
+            if "error" in ev:
+                merged["error"] = ev["error"]
+            rendered[idx] = (None, json.dumps(merged))
+        elif kind == "span_end":
+            # begin fell off the raw window; the end alone still carries
+            # the fact (name + dur_ms) — render it as a collapsed span
+            # so e.g. a multi-second checkpoint_save finishing right
+            # before death isn't silently absent from the tail
+            merged = {k2: v for k2, v in ev.items()
+                      if k2 not in ("span", "parent", "depth", "tid",
+                                    "mono")}
+            merged["kind"] = "span"
+            rendered.append((None, json.dumps(merged)))
+        else:
+            rendered.append((None, line))
+    return [text for _sid, text in rendered[-k:]]
+
+
+def _print_trace_report(tdir: str) -> None:
+    """Run tools/trace_report.py over the telemetry dir and echo its
+    gang-wide analysis (straggler flags, step breakdown, collective
+    bandwidth) into the supervisor's stderr next to the flight tails.
+    Subprocess on purpose: the report is stdlib-only and must not be able
+    to wedge the supervisor even if the telemetry dir is garbage."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "trace_report.py")
+    if not os.path.isfile(script):
+        return
+    try:
+        res = subprocess.run([sys.executable, script, tdir],
+                             capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"launch.py: trace report failed: {e}", file=sys.stderr)
+        return
+    body = (res.stdout or "").strip()
+    if body:
+        print("launch.py: gang trace report:", file=sys.stderr)
+        for line in body.splitlines():
+            print(f"  {line}", file=sys.stderr)
+    if res.returncode == 3:
+        print("launch.py: trace report flagged anomalies (exit 3) — see "
+              "above", file=sys.stderr)
+
+
+def _reexport_trace(tdir) -> None:
+    """Re-merge the gang Chrome trace after EVERY rank has been reaped.
+
+    With MX_TRACE_EXPORT on, rank 0's own atexit hook merges the gang
+    trace at rank 0's process exit — but peer ranks may still be running
+    (rank 0 finishing first is the NORMAL case when another rank is the
+    straggler), so that merge can read their streams mid-write and drop
+    exactly the straggler tail the trace exists to show.  The supervisor
+    owns the only moment the files are known complete, so it re-runs the
+    merge and overwrites rank 0's best-effort trace.json.  Subprocess on
+    purpose (like _print_trace_report): the exporter lives in
+    mxnet_tpu.telemetry, whose import pulls in jax, which must not be
+    able to wedge the supervisor."""
+    raw = os.environ.get("MX_TRACE_EXPORT", "").strip()
+    if not tdir or not raw or raw.lower() in ("0", "false", "off"):
+        return
+    target = tdir if raw.lower() in ("1", "true", "on") else raw
+    env = dict(os.environ)
+    # the child must neither re-race the export from its own atexit nor
+    # attach a recorder that pollutes the run's streams (empty
+    # MX_TELEMETRY_DIR leaves telemetry disabled at import)
+    env.pop("MX_TRACE_EXPORT", None)
+    env["MX_TELEMETRY_DIR"] = ""
+    code = ("import sys\n"
+            "from mxnet_tpu import telemetry\n"
+            "telemetry.export_chrome_trace(sys.argv[1], out=sys.argv[2])\n")
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code, tdir,
+             os.path.join(target, "trace.json")],
+            capture_output=True, text=True, timeout=120, env=env)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"launch.py: gang trace re-export failed: {e}",
+              file=sys.stderr)
+        return
+    if res.returncode != 0:
+        print("launch.py: gang trace re-export failed: "
+              f"{(res.stderr or '').strip()[-500:]}", file=sys.stderr)
 
 
 class _HeartbeatMonitor:
@@ -141,9 +266,11 @@ class _HeartbeatMonitor:
                 self._stale.discard(rank)
 
     def diagnose(self) -> None:
-        """After a gang death: last heartbeat per rank + flight tail."""
+        """After a gang death: last heartbeat per rank + flight tail +
+        the gang-wide trace report (straggler flags, step breakdown)."""
         if self.dir is None:
             return
+        saw_events = False
         for rank in range(self.num):
             rec = self._read(rank)
             if rec is not None:
@@ -152,10 +279,13 @@ class _HeartbeatMonitor:
                       f"ago at step {rec.get('step')}", file=sys.stderr)
             tail = _flight_tail(self.dir, rank)
             if tail:
+                saw_events = True
                 print(f"launch.py: flight recorder tail (rank {rank}, "
                       f"last {len(tail)} events):", file=sys.stderr)
                 for line in tail:
                     print(f"  {line}", file=sys.stderr)
+        if saw_events:
+            _print_trace_report(self.dir)
 
 
 def _free_port() -> int:
@@ -302,9 +432,14 @@ def launch_local(num_workers: int, command, env_extra=None,
             t.join(timeout=5.0)
         history.append((attempt, [p.returncode for p in procs]))
         if rc == 0:
+            # every rank is reaped: the trace files are complete, so the
+            # authoritative gang-wide merge happens HERE (rank 0's atexit
+            # merge may have raced still-running peers)
+            _reexport_trace(monitor.dir)
             return 0
         monitor.diagnose()
         if attempt >= max_restarts:
+            _reexport_trace(monitor.dir)
             if max_restarts > 0:
                 print(f"launch.py: giving up after {attempt + 1} attempts; "
                       "per-rank exit history:", file=sys.stderr)
