@@ -132,18 +132,18 @@ def main():
     lb = nd.array(tgt_out.astype(np.float32))
 
     tokens_per_step = int((tgt_out != PAD).sum())
-    t0 = time.time()
+    t0 = time.perf_counter()
     for i in range(args.steps):
         # lazy AsyncLoss: forced at step 0 (compile split) and at the end
         loss = step.step((sb, tb), lb)
         if i == 0:
             val = float(loss)
             print(f"step 0: loss={val:.4f} (compile "
-                  f"{time.time() - t0:.1f}s)", flush=True)
-            t0 = time.time()
+                  f"{time.perf_counter() - t0:.1f}s)", flush=True)
+            t0 = time.perf_counter()
     step.drain()
     val = float(loss)
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     rate = tokens_per_step * max(args.steps - 1, 1) / dt
     print(f"final loss {val:.4f}  {rate:.0f} tok/s")
 

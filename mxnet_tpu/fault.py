@@ -231,9 +231,13 @@ def install_preemption_handler(ckpt, params, trainer=None,
     off-cycle checkpoint as a freshest-effort snapshot.
 
     Returns the installed handler (mainly for tests)."""
-    def _handler(signum, frame):
-        import sys as _sys
+    # bound OUTSIDE the handler: even `import sys` re-enters the import
+    # machinery (and its lock) when run inside a signal handler — the
+    # exact deadlock the sys.modules lookup below exists to avoid
+    # (mxlint signal-unsafe)
+    import sys as _sys
 
+    def _handler(signum, frame):
         # drain the async dispatch windows first: a pending step must land
         # in the device buffers before the sync snapshot reads them, and a
         # deferred failure must not masquerade as a checkpoint error.

@@ -134,20 +134,20 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
         self.priority = 1000
 
     def train_begin(self, estimator, *args, **kwargs):
-        self.train_start = time.time()
+        self.train_start = time.perf_counter()
         self.logger.info("Training begin")
 
     def train_end(self, estimator, *args, **kwargs):
-        t = time.time() - self.train_start
+        t = time.perf_counter() - self.train_start
         self.logger.info("Training finished in %.3fs", t)
 
     def epoch_begin(self, estimator, *args, **kwargs):
-        self.epoch_start = time.time()
+        self.epoch_start = time.perf_counter()
         self.batch_index = 0
         self.processed_samples = 0
 
     def epoch_end(self, estimator, *args, **kwargs):
-        t = time.time() - self.epoch_start
+        t = time.perf_counter() - self.epoch_start
         msg = f"Epoch[{self.current_epoch}] finished in {t:.3f}s: "
         for m in self.metrics:
             name, value = m.get()

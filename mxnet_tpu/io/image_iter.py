@@ -138,7 +138,12 @@ class ImageRecordIter(DataIter):
             for i in range(nbatch)
         ]
         self._queue = queue.Queue(maxsize=self._prefetch)
-        self._batch_cursor = 0
+        # _stop_workers() joined the old epoch's workers above, but the
+        # cursor is the one field the NEW workers also mutate — taking the
+        # assignment lock here makes the reset manifestly ordered instead
+        # of relying on the join for the happens-before
+        with self._lock:
+            self._batch_cursor = 0
         self._produced = 0
         self._consumed = 0
         self._stop = False

@@ -454,12 +454,14 @@ class _ThreadedIter(DataIter):
         self._thread.start()
 
     def reset(self):
+        import queue
+
         self._gen += 1  # retire the current worker at its next gen check
         thread = self._thread
         while thread is not None and thread.is_alive():
             try:  # unblock a worker parked on a full queue
                 self._queue.get(timeout=0.05)
-            except Exception:
+            except queue.Empty:
                 pass
         if thread is not None:
             thread.join()
@@ -468,8 +470,6 @@ class _ThreadedIter(DataIter):
         # shuffle (or double-consume) batches
         self._iter.reset()
         self._done = False
-        import queue
-
         self._queue = queue.Queue(maxsize=self._QUEUE_DEPTH)
         self._start()
 

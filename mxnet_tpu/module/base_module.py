@@ -147,7 +147,7 @@ class BaseModule:
             self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            tic = time.perf_counter()
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
@@ -172,7 +172,7 @@ class BaseModule:
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
+                             time.perf_counter() - tic)
 
             if epoch_end_callback is not None:
                 arg_p, aux_p = self.get_params()

@@ -312,6 +312,8 @@ class FusedUpdater(Updater):
                     new_ss.append(ns)
                 return tuple(new_ws), tuple(new_ss)
 
+            # mxlint: disable=retrace-hazard — cached in _fn_cache per
+            # (optimizer, static hypers, kinds, donate); built once per key
             fn = jax.jit(fused_fn,
                          donate_argnums=(0, 2) if donate else ())
             self._fn_cache[key] = fn
@@ -382,6 +384,8 @@ class FusedUpdater(Updater):
         if engine.is_naive():
             import jax
 
+            # mxlint: disable=hot-sync — MXNET_ENGINE_TYPE=NaiveEngine
+            # CONTRACT: synchronous per-op dispatch, sync is the feature
             jax.block_until_ready(new_ws)
         nbytes = 0
         for (index, _g, w, s, _k), nw, ns in zip(group, new_ws, new_ss):

@@ -38,6 +38,8 @@ def _global_put(arr, sharding):
 
     if sharding.is_fully_addressable:
         return jax.device_put(arr, sharding)
+    # mxlint: disable=hot-sync — materializes the host INPUT batch for
+    # per-shard placement; never a readback of device compute
     host = np.asarray(arr)
     return jax.make_array_from_callback(
         host.shape, sharding, lambda idx: host[idx])
@@ -431,6 +433,8 @@ class DataParallelStep:
         # so keep it for accelerators and skip it on CPU hosts.
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
         donate = (0, 1) if (self._donate and mesh_platform != "cpu") else ()
+        # mxlint: disable=retrace-hazard — built ONCE per step object
+        # (guarded by `self._jitted is None` in _step_impl)
         self._jitted = jax.jit(
             step,
             out_shardings=(self._shardings, None, repl),
@@ -688,7 +692,9 @@ class DataParallelStep:
 
     def _current_lr(self, num_update: int) -> float:
         if self._lr_scheduler is not None:
+            # mxlint: disable=hot-sync — python lr schedule, host scalar
             return float(self._lr_scheduler(num_update))
+        # mxlint: disable=hot-sync — host python scalar, never on device
         return float(self._lr)
 
     @property

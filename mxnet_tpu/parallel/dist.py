@@ -144,6 +144,9 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
             try:
                 jax.distributed.shutdown()
             except Exception:
+                # best-effort teardown of the half-initialized client while
+                # already on the retry path — the real error is re-raised
+                # or retried below
                 pass
             remaining = deadline - time.monotonic()
             if remaining <= 0:

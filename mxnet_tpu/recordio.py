@@ -64,6 +64,8 @@ class MXRecordIO:
         try:
             self.close()
         except Exception:
+            # interpreter teardown: the file object or its module may
+            # already be finalized — nothing actionable at this point
             pass
 
     def __getstate__(self):

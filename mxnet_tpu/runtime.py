@@ -36,6 +36,8 @@ def _detect():
 
         feats["TPU"] = any(d.platform != "cpu" for d in jax.devices())
     except Exception:
+        # feature probe: jax missing, backend init failure, or a dead
+        # TPU runtime all mean the same thing here — no TPU visible
         pass
     try:
         import cv2  # noqa: F401

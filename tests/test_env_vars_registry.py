@@ -5,9 +5,16 @@ The registry is the single answer to "is MXNET_X supported here?" — a
 variable consumed at some use-site but absent from the table silently
 drifts out of the documentation, out of `env_vars.check()`'s
 set-but-ineffective warnings, and out of docs/OBSERVABILITY.md's knob
-list.  This test greps the tree so adding an env read without registering
-it fails tier-1 immediately.
+list.
+
+Since the mxlint PR this test delegates to the `env-unregistered` rule
+(tools/mxlint.py): same convention — a quoted MX_/MXNET_ name is a
+use-site — but at the AST level, so docstring mentions like "MX_FOO" no
+longer false-positive the way the old quoted-string regex could, and the
+finding carries the offending file.  Adding an env read without
+registering it still fails tier-1 immediately.
 """
+import importlib.util
 import os
 import re
 
@@ -15,32 +22,39 @@ from mxnet_tpu import env_vars
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# a quoted MX_/MXNET_ name is (by project convention) an env-var use-site:
-# os.environ.get("MX_X"), env_bool("MXNET_Y"), env dicts exported to
-# workers.  Prose mentions in docstrings are unquoted (or backticked), so
-# they don't match.
-_NAME = re.compile(r"""["'](MX(?:NET)?_[A-Z0-9_]+)["']""")
+_spec = importlib.util.spec_from_file_location(
+    "mxlint", os.path.join(_REPO, "tools", "mxlint.py"))
+_mxlint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mxlint)
+
+_NAME_IN_MSG = re.compile(r"env var '(MX(?:NET)?_[A-Z0-9_]+)'")
 
 
-def _scan():
+def _scan(registry):
+    """name -> sorted files, for every AST-level use-site the
+    env-unregistered rule reports against `registry`."""
+    findings, _stats = _mxlint.run_lint(
+        ["mxnet_tpu", "tools"], root=_REPO, rules=["env-unregistered"],
+        env_registry=registry)
     sites = {}
-    for top in ("mxnet_tpu", "tools"):
-        for dirpath, _dirnames, filenames in os.walk(os.path.join(_REPO, top)):
-            for fname in filenames:
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path, encoding="utf-8", errors="replace") as f:
-                    text = f.read()
-                for m in _NAME.finditer(text):
-                    rel = os.path.relpath(path, _REPO)
-                    sites.setdefault(m.group(1), set()).add(rel)
+    # meta rules (bad-suppression, syntax-error) always run; their
+    # findings are someone else's problem (test_lint's full-tree gate) —
+    # only env-unregistered messages carry a var name to parse
+    for f in findings:
+        if f.rule != "env-unregistered":
+            continue
+        m = _NAME_IN_MSG.search(f.message)
+        assert m, f"unparseable env-unregistered message: {f.message}"
+        sites.setdefault(m.group(1), set()).add(f.path)
     return sites
 
 
 def test_every_env_var_in_tree_is_registered():
-    sites = _scan()
-    assert sites, "scanner found no env vars at all — regex or layout broke"
+    # one scan with an EMPTY registry reports every use-site; the missing
+    # set is then a plain membership check against ENV_VARS.  Zero hits
+    # means the scanner (or the tree layout) broke.
+    sites = _scan(registry=set())
+    assert sites, "scanner found no env vars at all — rule or layout broke"
     missing = {name: sorted(files) for name, files in sorted(sites.items())
                if name not in env_vars.ENV_VARS}
     assert not missing, (

@@ -117,7 +117,7 @@ def run_task(name, argv, extra_env=None, timeout=1800, validator=None):
     """
     env = _bench._axon_env()
     env.update(extra_env or {})
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         p = subprocess.run(argv, cwd=_REPO, env=env, capture_output=True,
                            text=True, timeout=timeout)
@@ -129,7 +129,7 @@ def run_task(name, argv, extra_env=None, timeout=1800, validator=None):
         out = te.stdout if isinstance(te.stdout, str) else (
             te.stdout.decode() if te.stdout else "")
         rc, err = -1, f"TIMEOUT after {timeout}s"
-    dt = round(time.time() - t0, 1)
+    dt = round(time.perf_counter() - t0, 1)
     rec = {"task": name, "rc": rc, "s": dt,
            "stdout_tail": out.strip().splitlines()[-4:] if out else [],
            "stderr_tail": err.strip().splitlines()[-2:] if err else []}

@@ -98,10 +98,10 @@ def main():
     ap.add_argument("--out", default=os.path.join(_REPO, "CONSISTENCY.json"))
     args = ap.parse_args()
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     if not _probe():
         report = {"skipped": True, "reason": "no TPU backend answered probe",
-                  "elapsed_s": round(time.time() - t0, 1)}
+                  "elapsed_s": round(time.perf_counter() - t0, 1)}
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
         print(json.dumps(report))
@@ -150,7 +150,7 @@ def main():
                                  "no partial results" if timed_out
                                  else "tpu child produced no results"),
                       "child_tail": tail,
-                      "elapsed_s": round(time.time() - t0, 1)}
+                      "elapsed_s": round(time.perf_counter() - t0, 1)}
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
             print(json.dumps(report))
@@ -203,7 +203,7 @@ def main():
         "tpu_errors": tpu_errors,
         "cpu_errors": cpu_errors,
         "rtol": RTOL, "atol": ATOL,
-        "elapsed_s": round(time.time() - t0, 1),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
     }
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)

@@ -39,9 +39,9 @@ def probe():
     """Fresh (uncached) relay probe via bench.py's machinery — it builds
     the axon env (PYTHONPATH=/root/.axon_site + JAX_PLATFORMS) and rejects
     cpu-only answers; one attempt, no backoff burn."""
-    t0 = time.time()
+    t0 = time.perf_counter()
     ok = _bench._probe_tpu([], use_cache=False, attempts=1)
-    print(json.dumps({"probe": ok, "s": round(time.time() - t0, 1),
+    print(json.dumps({"probe": ok, "s": round(time.perf_counter() - t0, 1),
                       "t": time.strftime("%H:%M:%S")}), flush=True)
     return ok
 
