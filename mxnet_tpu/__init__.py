@@ -52,6 +52,7 @@ from .attribute import AttrScope
 from .monitor import Monitor
 from . import profiler
 from . import telemetry
+from . import memwatch
 from . import runtime
 from . import util
 from .util import is_np_array

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import fault
+from . import memwatch
 from . import telemetry
 from .base import MXNetError
 
@@ -122,6 +123,9 @@ class AsyncCheckpointer:
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: Optional[BaseException] = None
         self._closed = False
+        # live-array census: queued host snapshots are the "checkpoint"
+        # category (host bytes — the params were copied off device)
+        memwatch.register("checkpoint", self, _queued_snapshot_arrays)
         self._writer = threading.Thread(target=self._writer_loop, daemon=True)
         self._writer.start()
 
@@ -138,6 +142,9 @@ class AsyncCheckpointer:
         # the supervisor's liveness signal: rate-limited, atomic-renamed,
         # no-op without MX_TELEMETRY_DIR
         telemetry.heartbeat(self._step)
+        # memory watchdog: a step boundary on the host, safely outside
+        # any dispatch body (samples every MX_MEMWATCH_EVERY calls)
+        memwatch.on_step(self._step)
         if self._step % self.save_every != 0:
             return False
         snap = {
@@ -249,6 +256,9 @@ class AsyncCheckpointer:
         with telemetry.span("checkpoint_save", paired=True,
                             step=snap["step"]):
             self._write_impl(snap)
+        # sample while the snapshot buffers are still resident — the
+        # checkpoint category's high-water moment
+        memwatch.on_checkpoint("save", snap["step"])
 
     def _write_impl(self, snap):
         from .ndarray import utils as nd_utils
@@ -323,6 +333,20 @@ class AsyncCheckpointer:
                 "save", step=step, wall_s=time.perf_counter() - t0,
                 nbytes=nbytes)
         fault.on_write_published(step, final)
+
+
+def _queued_snapshot_arrays(ckpt):
+    """memwatch provider: host param copies waiting on the writer queue
+    (numpy arrays — counted as the checkpoint category's host bytes)."""
+    out = []
+    try:
+        items = list(ckpt._queue.queue)
+    except Exception:
+        return out
+    for snap in items:
+        if isinstance(snap, dict):
+            out.extend(snap.get("params", {}).values())
+    return out
 
 
 def _sha256_file(path: str) -> str:
@@ -434,7 +458,9 @@ def load_checkpoint_state(directory: str, step: Optional[int] = None):
     an invalid/missing step-N raises (gang-consistent resume must not
     silently diverge)."""
     with telemetry.span("checkpoint_load", paired=True):
-        return _load_checkpoint_state(directory, step)
+        state = _load_checkpoint_state(directory, step)
+    memwatch.on_checkpoint("load", state["step"] if state else 0)
+    return state
 
 
 def _load_checkpoint_state(directory: str, step: Optional[int] = None):

@@ -32,7 +32,39 @@ __all__ = [
     "num_gpus",
     "num_tpus",
     "pin_platform",
+    "normalize_memory_stats",
 ]
+
+
+def normalize_memory_stats(raw) -> dict:
+    """Normalize a PjRt ``Device.memory_stats()`` result to a stable
+    schema: ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+    "available"}``.
+
+    PjRt's dict is backend-dependent (TPU/GPU expose the TCMalloc-style
+    allocator counters; XLA:CPU returns ``None``), and the raw shape was
+    leaking to callers — ``Context.memory_stats()`` used to hand back the
+    raw dict or a silent ``None``.  The CPU fallback is documented:
+    ``available=False`` with zeroed counters, so callers branch on ONE
+    flag instead of probing for keys; ``mxnet_tpu.memwatch`` then derives
+    usage from the ``jax.live_arrays()`` census instead.  A dict without
+    ``bytes_in_use`` counts as unavailable too — all-zero counters must
+    never masquerade as a real reading."""
+    if not isinstance(raw, dict) or "bytes_in_use" not in raw:
+        return {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                "bytes_limit": 0, "available": False}
+
+    def _int(key, default=0):
+        try:
+            return int(raw.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    in_use = _int("bytes_in_use")
+    return {"bytes_in_use": in_use,
+            "peak_bytes_in_use": _int("peak_bytes_in_use", in_use),
+            "bytes_limit": _int("bytes_limit"),
+            "available": True}
 
 _ACCEL_TYPES = ("tpu", "gpu")
 
@@ -124,10 +156,21 @@ class Context:
     def empty_cache(self):
         """Reference: mx.context.Context.empty_cache; PjRt pools internally."""
 
-    def memory_stats(self):
+    def memory_stats(self) -> dict:
+        """This device's memory stats, normalized to the stable schema of
+        :func:`normalize_memory_stats` — never ``None``: backends without
+        allocator stats (XLA:CPU) return ``available=False`` with zeroed
+        counters (``mxnet_tpu.memwatch`` falls back to the live-array
+        census there)."""
         dev = self.jax_device
         stats = getattr(dev, "memory_stats", None)
-        return stats() if stats else None
+        raw = None
+        if stats is not None:
+            try:
+                raw = stats()
+            except Exception:
+                raw = None
+        return normalize_memory_stats(raw)
 
 
 def _accel_devices() -> List:

@@ -165,6 +165,24 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_TRACE_HEARTBEAT_GAP_SEC": (
         "honored", "trace_report.py flags stretches where a rank's event "
         "stream went silent longer than this many seconds (default 30)"),
+    # memory & compile observability (docs/OBSERVABILITY.md §Memory)
+    "MX_MEMWATCH": (
+        "honored", "device-memory watchdog riding the telemetry "
+        "recorder (memwatch.py): on by default whenever MX_TELEMETRY_DIR "
+        "is set; 0 disables the whole subsystem — sampling, compile "
+        "accounting (incl. the analysis retrace), and OOM post-mortems; "
+        "'full' additionally captures compiled memory_analysis() "
+        "temp/arg/output bytes per executable at the cost of one "
+        "duplicate XLA compile each"),
+    "MX_MEMWATCH_EVERY": (
+        "honored", "memory-sample cadence: one live-array census + "
+        "device memory_stats snapshot every N step-boundary "
+        "observations (default 10; memwatch.on_step — checkpoint "
+        "save/load always samples)"),
+    "MX_MEMWATCH_LEAK_WINDOW": (
+        "honored", "sliding-window length of the monotonic-growth leak "
+        "detector (default 12 samples; memwatch.py sample(), also the "
+        "default verdict window of tools/mem_report.py)"),
 }
 
 _warned = False

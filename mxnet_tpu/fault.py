@@ -13,11 +13,18 @@ Fault spec grammar (``MX_FAULT_SPEC``, ';'-separated specs)::
 
     spec       := kind (":" key "=" value)*
     kind       := "crash" | "crash-write" | "torn-write" | "slow-write"
+                | "oom"
     key        := "step" | "ms" | "file" | "rank" | "if-restart"
 
   crash:step=N        hard os._exit(EXIT_INJECTED_CRASH) when the training
                       step counter reaches N (before N's checkpoint is
                       enqueued — deterministic: step N is never on disk)
+  oom:step=N          raise a synthetic RESOURCE_EXHAUSTED inside step N's
+                      dispatch (DataParallelStep calls on_dispatch before
+                      handing the program to jax), so the OOM post-mortem
+                      path — memwatch.emit_oom_report + the supervisor's
+                      death diagnosis — is testable without real HBM
+                      exhaustion
   crash-write:step=N  die mid-write of step N's checkpoint: payload files
                       are on disk but meta.json is not, and the staging
                       ``.tmp-N`` dir is left behind (never published)
@@ -52,7 +59,7 @@ EXIT_INJECTED_CRASH = 57
 # tools/launch.py hard-codes the same value (it must not import jax).
 EXIT_PREEMPTED = 83
 
-_KINDS = ("crash", "crash-write", "torn-write", "slow-write")
+_KINDS = ("crash", "crash-write", "torn-write", "slow-write", "oom")
 _KEYS = ("step", "ms", "file", "rank", "if-restart")
 
 
@@ -123,7 +130,8 @@ def parse_spec(text: str) -> List[Fault]:
                         f"MX_FAULT_SPEC: {key}= wants an integer, got "
                         f"{val!r}") from None
         f = Fault(kind, **kw)
-        if f.kind in ("crash", "crash-write", "torn-write") and f.step is None:
+        if f.kind in ("crash", "crash-write", "torn-write", "oom") \
+                and f.step is None:
             raise MXNetError(f"MX_FAULT_SPEC: {f.kind} requires step=N")
         if f.kind == "slow-write" and f.ms is None:
             raise MXNetError("MX_FAULT_SPEC: slow-write requires ms=N")
@@ -166,6 +174,20 @@ def on_train_step(step: int) -> None:
     if f is not None and f.step == step:
         print(f"mxnet_tpu.fault: injected crash at step {step}", flush=True)
         os._exit(EXIT_INJECTED_CRASH)
+
+
+def on_dispatch(step: int) -> None:
+    """``oom`` injection point — ``DataParallelStep._step_impl`` calls
+    this right before handing the step program to jax.  The synthetic
+    error spells RESOURCE_EXHAUSTED exactly like PjRt's XlaRuntimeError
+    status text, so the same ``memwatch.is_resource_exhausted`` match
+    routes it through the real OOM post-mortem path."""
+    f = _match("oom", step)
+    if f is not None and f.step == step:
+        raise MXNetError(
+            f"RESOURCE_EXHAUSTED: injected device OOM at step {step} "
+            f"(MX_FAULT_SPEC): out of memory while allocating step "
+            f"buffers")
 
 
 def on_write_begin(step: int) -> None:

@@ -353,6 +353,7 @@ class CachedOp:
         train = autograd.is_training()
         cache_key = (train, in_treedef)
         jfn = self._jitted.get(cache_key)
+        was_cold = jfn is None
         if jfn is None:
             jfn = self._build(cache_key, train, ctx, in_treedef)
             self._jitted[cache_key] = jfn
@@ -362,15 +363,31 @@ class CachedOp:
         # shape-churning data pipelines silently spend their time compiling
         from .. import telemetry
 
+        shape_sig = None
         if telemetry.retrace_enabled():
-            telemetry.note_signature(
-                self._tele_name,
-                (train, str(in_treedef),
-                 tuple((tuple(x.shape), str(x._data.dtype)) for x in in_nds)))
+            # note_signature returns True for a NEW signature = this call
+            # traces + XLA-compiles; OR with was_cold so a second
+            # executor over a seen signature still books its compile.
+            # With detection OFF, traced falls back to the first build
+            # per cache key only — per-shape respecializations then go
+            # unbooked, by design: the kill switch exists to remove the
+            # per-call signature probe that would detect them
+            shape_sig = tuple((tuple(x.shape), str(x._data.dtype))
+                              for x in in_nds)
+            traced = telemetry.note_signature(
+                self._tele_name, (train, str(in_treedef), shape_sig)) \
+                or was_cold
+        else:
+            traced = was_cold
 
         key = _random.next_key()
         arrays = tuple(p._data for p in param_nds)
         in_arrays = [x._data for x in in_nds]
+        import time as _time
+
+        # timed only when a compile event can fire: the warm steady-state
+        # path (cached jit, detection off) must pay nothing here
+        t0 = _time.perf_counter() if traced else 0.0
 
         recording = autograd.is_recording()
         if recording:
@@ -395,6 +412,26 @@ class CachedOp:
                                  fwd_fn=flat_fwd)
         else:
             outs = jfn(arrays, key, *in_arrays)
+
+        if traced:
+            # one compile event per specialized executable of this block
+            # (per train flag + treedef + input signature) — never
+            # re-emitted on the cached steady-state path
+            from .. import memwatch
+
+            if shape_sig is None:  # detection off: built only on compile
+                shape_sig = tuple((tuple(x.shape), str(x._data.dtype))
+                                  for x in in_nds)
+            memwatch.note_compile(
+                self._tele_name,
+                ("CachedOp", type(self.block).__name__, train,
+                 str(in_treedef), shape_sig,
+                 tuple((tuple(a.shape), str(a.dtype)) for a in arrays)),
+                wall_s=_time.perf_counter() - t0, site="cached_op",
+                jitted=jfn,
+                args=(memwatch.shape_structs(arrays),
+                      memwatch.shape_structs(key),
+                      *memwatch.shape_structs(tuple(in_arrays))))
 
         n_out = self._n_out[cache_key]
         out_nds = [NDArray(o, ctx=ctx) for o in outs[:n_out]]

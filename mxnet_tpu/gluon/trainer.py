@@ -197,6 +197,11 @@ class Trainer:
                     n_jitted_calls=info["n_jitted_calls"],
                     step=self._step_count)
             telemetry.heartbeat(self._step_count)
+        # memory watchdog step boundary (after the dispatches, outside
+        # any hot dispatch body; samples every MX_MEMWATCH_EVERY calls)
+        from .. import memwatch
+
+        memwatch.on_step(self._step_count)
 
     def drain(self) -> None:
         """Block until every in-flight update has landed in the parameter

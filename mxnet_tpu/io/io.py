@@ -556,6 +556,28 @@ class PrefetchingIter(_ThreadedIter):
         return self._renamed(self._iter.provide_label, self._rename_label)
 
 
+def _staged_batch_arrays(it):
+    """memwatch provider: device arrays of batches parked in the prefetch
+    queue (staged but not yet consumed by a step)."""
+    out = []
+    try:
+        items = list(it._queue.queue)
+    except Exception:
+        return out
+    for item in items:
+        if not (isinstance(item, tuple) and len(item) == 3):
+            continue
+        _gen, kind, payload = item
+        if kind != "batch" or payload is None:
+            continue
+        for nd in list(getattr(payload, "data", None) or ()) + \
+                list(getattr(payload, "label", None) or ()):
+            data = getattr(nd, "_data", None)
+            if data is not None:
+                out.append(data)
+    return out
+
+
 class DevicePrefetchIter(_ThreadedIter):
     """Device-side input prefetch: wraps any DataIter and stages the NEXT
     batch onto a ``DataParallelStep``'s input shardings (via its
@@ -578,6 +600,11 @@ class DevicePrefetchIter(_ThreadedIter):
         self._QUEUE_DEPTH = max(1, int(depth))
         super().__init__(data_iter,
                          batch_size=getattr(data_iter, "batch_size", 0))
+        # live-array census: batches staged on device ahead of the step
+        # are the "inflight" slice of the memory watchdog
+        from .. import memwatch
+
+        memwatch.register("inflight", self, _staged_batch_arrays)
 
     def _produce(self):
         batch = self._iter.next()

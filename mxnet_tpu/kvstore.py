@@ -388,6 +388,19 @@ class KVStore:
                 nbytes=int(np.prod(shape)) * vals[0]._data.dtype.itemsize,
                 wall_s=_time.perf_counter() - t0, ndev=len(vals),
                 traced=traced)
+            if traced:
+                # one compile event per specialized psum executable — the
+                # cache-entry schema the AOT executable cache will key on
+                from . import memwatch
+
+                memwatch.note_compile(
+                    "KVStore.device_allreduce",
+                    ("kvstore_psum", len(devices), shape,
+                     str(vals[0]._data.dtype)),
+                    wall_s=_time.perf_counter() - t0, site="kvstore",
+                    jitted=fn,
+                    args=(memwatch.shape_structs(stacked),),
+                    ndev=len(devices))
         return NDArray(reduced, ctx=vals[0].context)
 
     def _global_sum(self, nd):

@@ -37,11 +37,13 @@ returns the plain per-param ``Updater``).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .. import engine
+from .. import memwatch
 from .. import telemetry
 from .optimizer import Optimizer, Updater
 
@@ -273,6 +275,28 @@ def _write_state(s, new):
     s._set_data(new)
 
 
+def _flat_state_arrays(updater):
+    """memwatch provider: every optimizer-state buffer this updater owns
+    (momenta, Adam moments, fp32 masters), flattened out of the per-param
+    state tuples — the "optimizer" slice of the live-array census."""
+    out = []
+
+    def walk(s):
+        if s is None:
+            return
+        if isinstance(s, tuple):
+            for x in s:
+                walk(x)
+            return
+        data = getattr(s, "_data", None)
+        if data is not None:
+            out.append(data)
+
+    for s in updater.states.values():
+        walk(s)
+    return out
+
+
 class FusedUpdater(Updater):
     """Per-param-compatible updater with a fused ``apply([...])`` fast path.
 
@@ -288,6 +312,8 @@ class FusedUpdater(Updater):
         super().__init__(optimizer)
         self._fn_cache: Dict[Any, Any] = {}
         self.last_info: Optional[Dict[str, int]] = None
+        # live-array census: the states dict is the "optimizer" category
+        memwatch.register("optimizer", self, _flat_state_arrays)
 
     # -- fused executable cache -------------------------------------------
     def _jitted(self, spec, static, kinds, donate):
@@ -372,6 +398,10 @@ class FusedUpdater(Updater):
         kinds = tuple(kind for *_x, kind in group)
         static = spec.static(opt)
         donate = bool(donate) and ctx.jax_device.platform != "cpu"
+        # cold = this (optimizer, hypers, kinds, donate) executable is
+        # about to be built: the first call below pays trace + XLA
+        # compile and is booked as ONE compile event (never re-emitted)
+        cold = (spec.opt_name, static, kinds, donate) not in self._fn_cache
         fn = self._jitted(spec, static, kinds, donate)
         ws = tuple(w._data for _i, _g, w, _s, _k in group)
         gs = tuple(g._data for _i, g, _w, _s, _k in group)
@@ -379,8 +409,18 @@ class FusedUpdater(Updater):
         scalars = np.asarray([spec.scalars(opt, index)
                               for index, _g, _w, _s, _k in group],
                              dtype=np.float32)
-        new_ws, new_ss = fn(ws, gs, ss, scalars,
-                            np.float32(opt.rescale_grad))
+        rescale = np.float32(opt.rescale_grad)
+        t0 = time.perf_counter() if cold else 0.0
+        new_ws, new_ss = fn(ws, gs, ss, scalars, rescale)
+        if cold:
+            memwatch.note_compile(
+                f"FusedUpdater:{spec.opt_name}",
+                ("FusedUpdater", spec.opt_name, static, kinds, donate,
+                 tuple((tuple(w.shape), str(w.dtype)) for w in ws)),
+                wall_s=time.perf_counter() - t0, site="fused", jitted=fn,
+                args=memwatch.shape_structs((ws, gs, ss, scalars,
+                                             rescale)),
+                n_params=len(group))
         if engine.is_naive():
             import jax
 

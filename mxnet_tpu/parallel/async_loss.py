@@ -46,6 +46,7 @@ from typing import Optional
 
 import numpy as np
 
+from .. import memwatch
 from .. import telemetry
 from ..base import MXNetError
 
@@ -122,6 +123,13 @@ class _PendingHandle:
                 self._host = self._force()
                 return self._host
             except Exception as exc:
+                # under async dispatch a real device OOM surfaces HERE,
+                # at the deferred readback — post-mortem before wrapping
+                if memwatch.is_resource_exhausted(exc):
+                    memwatch.emit_oom_report(
+                        executor=self._executor, step=self._step,
+                        inflight_depth=(self._ring.depth
+                                        if self._ring is not None else 0))
                 # the failure belongs to the step that DISPATCHED the
                 # program, not to whatever line happened to force it later
                 self._exc = MXNetError(
@@ -200,6 +208,19 @@ class StepFence(_PendingHandle):
         return None
 
 
+def _pending_arrays(ring):
+    """memwatch provider: device buffers pinned by unforced handles."""
+    with ring._lock:
+        handles = list(ring._pending)
+    out = []
+    for h in handles:
+        v = getattr(h, "_value", None)
+        if v is not None:
+            out.append(v)
+        out.extend(getattr(h, "_arrays", None) or ())
+    return out
+
+
 class InflightRing:
     """Bounded ring of pending handles for ONE executor.
 
@@ -215,6 +236,9 @@ class InflightRing:
         self._lock = threading.Lock()
         with _rings_lock:
             _live_rings.add(self)
+        # live-array census: pending handles pin this step's loss/fence
+        # buffers — the "inflight" category of the memory watchdog
+        memwatch.register("inflight", self, _pending_arrays)
 
     def discard(self, handle) -> None:
         """Drop a handle the consumer forced out-of-band (float(loss))."""

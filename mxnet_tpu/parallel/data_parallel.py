@@ -20,6 +20,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import fault
+from .. import memwatch
 from .. import telemetry
 from ..base import MXNetError
 from .async_loss import AsyncLoss, InflightRing, inflight_limit
@@ -62,6 +64,20 @@ def _host_scalar(loss):
     if getattr(loss, "is_fully_addressable", True):
         return loss
     return np.asarray(loss.addressable_shards[0].data)
+
+
+def _params_arrays(step):
+    """memwatch provider: the sharded parameter buffers this step owns."""
+    return list((step.params or {}).values())
+
+
+def _opt_state_arrays(step):
+    """memwatch provider: optimizer-state buffers (momenta/Adam moments)."""
+    if step.opt_state is None:
+        return ()
+    import jax
+
+    return jax.tree_util.tree_leaves(step.opt_state)
 
 
 def _block_apply_fn(block, ctx, train: bool):
@@ -283,6 +299,14 @@ class DataParallelStep:
         # both trigger first-use state init, hence the lock
         self._inflight = InflightRing(self._tele_name)
         self._state_lock = threading.Lock()
+        # deferred compile record: _step_impl (the hot path — which must
+        # never run memory/analysis APIs, mxlint hot-sync) stamps what it
+        # knows at the traced call; step() hands it to memwatch after
+        self._pending_compile: Optional[Dict[str, Any]] = None
+        # live-array census attribution (docs/OBSERVABILITY.md §Memory):
+        # weak registration — the watchdog never keeps this step alive
+        memwatch.register("params", self, _params_arrays)
+        memwatch.register("optimizer", self, _opt_state_arrays)
 
     def _ensure_state(self, example_inputs):
         """Gather params (resolving deferred init via one eager forward) and
@@ -535,7 +559,17 @@ class DataParallelStep:
         breakdown.  Spans observe only; the computation is bitwise
         identical with ``MX_TELEMETRY_SPANS=0``."""
         with telemetry.span("train_step", executor=self._tele_name):
-            return self._step_impl(data, label)
+            handle = self._step_impl(data, label)
+        pend, self._pending_compile = self._pending_compile, None
+        if pend is not None:
+            # compile accounting happens HERE, outside the hot dispatch
+            # body: note_compile may retrace for cost analysis, which is
+            # a once-per-executable fact, not a per-step one
+            memwatch.note_compile(self._tele_name, pend["parts"],
+                                  pend["wall_s"], site="data_parallel",
+                                  jitted=self._jitted, args=pend["args"])
+        memwatch.on_step(self._step_count)
+        return handle
 
     def _step_impl(self, data, label):
         import jax
@@ -644,18 +678,59 @@ class DataParallelStep:
         else:
             pp_cm = contextlib.nullcontext()
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
+        lr_val = np.float32(self._current_lr(self._step_count + 1))
         with telemetry.span("dispatch", step=self._step_count + 1,
                             traced=traced):
-            with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
-                run = self._jitted
-                if profiler.is_recording():
-                    run = (lambda *a: profiler.timed_call(
-                        f"FusedStep:{type(self.block).__name__}",
-                        self._jitted, *a))
-                self.params, self.opt_state, loss = run(
-                    self.params, self.opt_state, key,
-                    np.float32(self._current_lr(self._step_count + 1)),
-                    data_arrs, label_arr)
+            try:
+                # chaos harness: `oom:step=N` raises a synthetic
+                # RESOURCE_EXHAUSTED here, exercising the same post-mortem
+                # path a real HBM exhaustion takes
+                fault.on_dispatch(self._step_count + 1)
+                with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
+                    run = self._jitted
+                    if profiler.is_recording():
+                        run = (lambda *a: profiler.timed_call(
+                            f"FusedStep:{type(self.block).__name__}",
+                            self._jitted, *a))
+                    self.params, self.opt_state, loss = run(
+                        self.params, self.opt_state, key, lr_val,
+                        data_arrs, label_arr)
+            except Exception as e:
+                if memwatch.is_resource_exhausted(e):
+                    # land the post-mortem (census, largest category, top
+                    # executables, window depth) on disk before dying
+                    memwatch.emit_oom_report(
+                        executor=name, step=self._step_count + 1,
+                        inflight_depth=self._inflight.depth)
+                raise
+        if traced and telemetry.enabled():
+            # what step() needs to book the compile once the hot body is
+            # done: structural fingerprint parts + arg shape mirrors
+            # (metadata only — the placed buffers are not kept alive)
+            shape_sig = (
+                tuple((tuple(np.shape(a)), str(a.dtype))
+                      for a in data_arrs),
+                (tuple(np.shape(label_arr)),
+                 str(getattr(label_arr, "dtype", ""))))
+            # hypers baked into the trace as CONSTANTS are executable
+            # identity too: two steps differing only in momentum (or
+            # remat, or the loss class) compile different programs and
+            # must not collide on the restart-stable fingerprint
+            hyper_sig = (self._momentum, self._wd, self._rescale,
+                         self._beta1, self._beta2, self._eps,
+                         self._clip_gradient, self._clip_global,
+                         self._remat, self._ring, self._pp_micro,
+                         type(self.loss_fn).__name__,
+                         tuple(sorted(self._mults.items())))
+            self._pending_compile = {
+                "parts": ("DataParallelStep", type(self.block).__name__,
+                          self._optimizer, self._accum, hyper_sig,
+                          tuple(self.mesh.shape.items()), shape_sig),
+                "wall_s": time.perf_counter() - t0,
+                "args": memwatch.shape_structs(
+                    (self.params, self.opt_state, key, lr_val,
+                     data_arrs, label_arr)),
+            }
         self._step_count += 1
         handle = AsyncLoss(loss, step=self._step_count, executor=name,
                            ring=self._inflight, host_fn=_host_scalar)

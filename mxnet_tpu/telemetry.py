@@ -919,6 +919,22 @@ def export_chrome_trace(directory: Optional[str] = None,
                                          * 1e3, 0.001),
                               "args": span_args(ev),
                               "_sub": idx})
+            elif kind == "mem":
+                # per-rank counter track: category bytes render as a
+                # stacked area series under the span timeline (Perfetto
+                # ph "C"); sampled off the hot path so ts is the wall
+                # stamp, like collectives
+                cats = ev.get("categories") or {}
+                args = {}
+                for cat, row in cats.items():
+                    args[cat] = (row.get("nbytes", 0)
+                                 if isinstance(row, dict) else row)
+                if not args:
+                    args = {"live_bytes": ev.get("live_bytes", 0)}
+                trace.append({"ph": "C", "name": "memory", "pid": rank_id,
+                              "tid": 0,
+                              "ts": float(ev.get("t", 0.0)) * 1e6,
+                              "args": args})
             elif kind == "collective":
                 op = str(ev.get("op", "collective"))
                 occ = n_coll.get(op, 0)
@@ -1039,6 +1055,29 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
         gauge("mx_heartbeat_age_seconds",
               round(max(0.0, time.time() - _state.hb_wall), 3))
     gauge("mx_restart_count", s["restart_count"])
+    # memory watchdog gauges (docs/OBSERVABILITY.md §Memory): lazy import
+    # — memwatch rides on this module, never the other way around
+    try:
+        from . import memwatch as _memwatch
+
+        ms = _memwatch.summary()
+        if ms["samples"]:
+            gauge("mx_mem_samples_total", ms["samples"], kind="counter")
+            gauge("mx_mem_watermark_bytes", ms["watermark_bytes"])
+            lines.append("# TYPE mx_mem_category_bytes gauge")
+            for cat, nb in sorted(ms["categories"].items()):
+                lines.append(
+                    f'mx_mem_category_bytes{{{rank_lbl},'
+                    f'category="{_prom_escape(cat)}"}} {nb}')
+            gauge("mx_mem_leak_detected",
+                  1 if ms["leak"]["active"] else 0)
+        if ms["compiles"]["count"]:
+            gauge("mx_mem_compile_total", ms["compiles"]["count"],
+                  kind="counter")
+            gauge("mx_mem_compile_ms_total", ms["compiles"]["wall_ms"],
+                  kind="counter")
+    except Exception:  # the snapshot must land even if memwatch breaks
+        pass
     lines.append("# EOF")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp-{os.getpid()}"

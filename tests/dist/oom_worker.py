@@ -1,0 +1,57 @@
+"""OOM-post-mortem-under-gang worker (docs/OBSERVABILITY.md §Memory
+acceptance shape): 2 ranks train independently (local per-rank mesh — no
+collective coupling, so the surviving rank is alive for the supervisor
+to tear down) with the memory watchdog sampling every step.  The test
+env injects ``MX_FAULT_SPEC=oom:step=N:rank=R``: rank R's dispatch
+raises a synthetic RESOURCE_EXHAUSTED at step N, memwatch records +
+flushes an ``oom_report`` event, and the launch.py supervisor's death
+diagnosis must echo the post-mortem (largest live-array category,
+watermark, in-flight depth) next to the flight tail."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# one CPU device per process BEFORE jax initializes (the pytest parent's
+# XLA_FLAGS asks for 8 virtual devices, unshardable for a batch of 8)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+os.environ.setdefault("MX_ASYNC_INFLIGHT", "2")
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402  (rendezvous runs at import)
+from mxnet_tpu import gluon, nd, telemetry
+from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+
+def main():
+    import jax
+
+    assert telemetry.enabled(), "MX_TELEMETRY_DIR must be set"
+    rank = jax.process_index()
+    steps = int(os.environ.get("OOM_STEPS", "8"))
+
+    mesh = local_mesh(devices=jax.local_devices())
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Normal(0.5))
+    step = DataParallelStep(net, gluon.loss.L2Loss(), mesh=mesh,
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05})
+    rng = np.random.RandomState(rank)
+    for _i in range(steps):
+        x = nd.array(rng.rand(8, 4).astype(np.float32))
+        y = nd.array(rng.rand(8, 4).astype(np.float32))
+        float(step.step(x, y))  # forces readback: deferred errors surface
+        # slow cadence: the surviving rank must still be mid-run when the
+        # injected rank dies, so the supervisor exercises full teardown
+        time.sleep(0.3)
+    step.drain()
+    telemetry.flush()
+    print(f"worker {rank}: oom worker finished clean", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,10 +48,47 @@ def test_spec_grammar():
     "crash",                   # crash requires step=
     "slow-write:step=3",       # slow-write requires ms=
     "torn-write:step=3:file=rng",  # bad file target
+    "oom",                     # oom requires step=
+    "oom:ms=5",                # oom requires step=
 ])
 def test_spec_rejects_bad_grammar(bad):
     with pytest.raises(MXNetError, match="MX_FAULT_SPEC"):
         fault.parse_spec(bad)
+
+
+def test_oom_spec_grammar_and_qualifiers(monkeypatch):
+    faults = fault.parse_spec("oom:step=3:rank=1")
+    assert faults[0].kind == "oom" and faults[0].step == 3
+    assert faults[0].rank == 1
+    monkeypatch.setenv("MX_PROC_ID", "0")
+    assert not faults[0].applies_here()
+
+
+def test_on_dispatch_raises_resource_exhausted_at_step(monkeypatch):
+    """The synthetic OOM spells RESOURCE_EXHAUSTED like PjRt's
+    XlaRuntimeError, fires only at the named step, and only on the
+    qualified rank."""
+    monkeypatch.setenv("MX_FAULT_SPEC", "oom:step=4")
+    fault.on_dispatch(3)  # not yet
+    with pytest.raises(MXNetError, match="RESOURCE_EXHAUSTED"):
+        fault.on_dispatch(4)
+    fault.on_dispatch(5)  # one-shot trigger step, not a threshold
+    monkeypatch.setenv("MX_FAULT_SPEC", "oom:step=4:rank=1")
+    monkeypatch.setenv("MX_PROC_ID", "0")
+    fault.on_dispatch(4)  # gated off this rank: no-op
+
+
+def test_injected_oom_routes_through_memwatch_match():
+    """memwatch classifies the injected error exactly like a real OOM."""
+    from mxnet_tpu import memwatch
+
+    try:
+        fault.parse_spec("oom:step=1")
+    except MXNetError:
+        pytest.fail("oom grammar must parse")
+    exc = MXNetError("RESOURCE_EXHAUSTED: injected device OOM at step 1")
+    assert memwatch.is_resource_exhausted(exc)
+    assert not memwatch.is_resource_exhausted(ValueError("boom"))
 
 
 def test_qualifiers_gate_by_rank_and_incarnation(monkeypatch):

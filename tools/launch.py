@@ -133,6 +133,61 @@ def _flight_tail(tdir: str, rank: int, k: int = FLIGHT_TAIL_EVENTS):
     return [text for _sid, text in rendered[-k:]]
 
 
+def _fmt_mb(n) -> str:
+    try:
+        return f"{float(n) / 1e6:.1f}MB"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _oom_report(tdir: str, rank: int):
+    """The newest ``oom_report`` event in a rank's stream, if any —
+    memwatch (mxnet_tpu/memwatch.py) records + flushes one before a
+    RESOURCE_EXHAUSTED re-raises, so a rank that died OOM carries its
+    own post-mortem (largest live-array category, watermark, in-flight
+    depth, top executables)."""
+    path = os.path.join(tdir, f"rank-{rank}.jsonl")
+    try:
+        with open(path, errors="replace") as f:
+            raw = deque(f, maxlen=512)
+    except OSError:
+        return None
+    found = None
+    for line in raw:
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(ev, dict) and ev.get("kind") == "oom_report":
+            found = ev
+    return found
+
+
+def _print_oom_report(ev: dict, rank: int) -> None:
+    cats = ev.get("categories") or {}
+    largest = ev.get("largest_category")
+    parts = [f"launch.py: rank {rank} OOM post-mortem"
+             + (f" (step {ev['step']})" if ev.get("step") is not None
+                else "") + ":"]
+    if largest:
+        parts.append(f"largest live-array category {largest} "
+                     f"({_fmt_mb(cats.get(largest, 0))} of "
+                     f"{_fmt_mb(ev.get('live_bytes', 0))} live);")
+    parts.append(f"watermark {_fmt_mb(ev.get('watermark_bytes', 0))};")
+    if ev.get("inflight_depth") is not None:
+        parts.append(f"inflight depth {ev['inflight_depth']};")
+    if ev.get("bytes_limit"):
+        parts.append(f"device limit {_fmt_mb(ev['bytes_limit'])};")
+    top = ev.get("top_executables") or []
+    if top:
+        t = top[0]
+        weight = (t.get("temp_bytes") or t.get("bytes_accessed")
+                  or t.get("arg_bytes") or 0)
+        parts.append(f"top executable {t.get('executor')}"
+                     f"[{t.get('fingerprint')}] ({_fmt_mb(weight)})")
+    print(" ".join(parts).rstrip(";"), file=sys.stderr)
+
+
 def _print_trace_report(tdir: str) -> None:
     """Run tools/trace_report.py over the telemetry dir and echo its
     gang-wide analysis (straggler flags, step breakdown, collective
@@ -284,6 +339,11 @@ class _HeartbeatMonitor:
                       f"last {len(tail)} events):", file=sys.stderr)
                 for line in tail:
                     print(f"  {line}", file=sys.stderr)
+            # a rank that died on RESOURCE_EXHAUSTED left a memory
+            # post-mortem — echo WHY next to the flight tail's WHERE
+            oom = _oom_report(self.dir, rank)
+            if oom is not None:
+                _print_oom_report(oom, rank)
         if saw_events:
             _print_trace_report(self.dir)
 

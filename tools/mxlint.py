@@ -10,9 +10,12 @@ Rules (each descends from a real bug — docs/STATIC_ANALYSIS.md has the
 full catalog with provenance):
 
   hot-sync             host readback (np.asarray / .item() / float() /
-                       jax.device_get / block_until_ready) reachable from
-                       a per-step dispatch body (PR 4: one stray sync
-                       stalls the whole async pipeline)
+                       jax.device_get / block_until_ready) or memory
+                       polling (.memory_stats() / jax.live_arrays() /
+                       .memory_analysis() — PR 8: sample via memwatch at
+                       step boundaries) reachable from a per-step
+                       dispatch body (PR 4: one stray sync stalls the
+                       whole async pipeline)
   raw-shard-map        any shard_map import/call outside
                        parallel/sharding.py's shard_map_compat shim
                        (PR 2: raw jax.shard_map fails on the pinned jax)
@@ -97,6 +100,11 @@ _SUPPRESS = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 # attribute calls that force a device->host round-trip
 SYNC_ATTRS = frozenset({"item", "asnumpy", "asscalar", "block_until_ready",
                         "device_get"})
+# memory-introspection calls (PR 8): cheap-ish individually, but
+# memory_stats() round-trips PjRt, live_arrays() walks every live buffer,
+# and memory_analysis() XLA-compiles — none belong in a per-step dispatch
+# body; sample at step boundaries via mxnet_tpu.memwatch instead
+MEM_ATTRS = frozenset({"memory_stats", "memory_analysis", "live_arrays"})
 
 
 class Finding:
@@ -754,6 +762,23 @@ class FileLint:
                 f".{f.attr}() forces a device->host sync inside the "
                 f"per-step dispatch path — defer readback (AsyncLoss) or "
                 f"move it off the hot path")
+            return
+        if isinstance(f, ast.Attribute) and f.attr in MEM_ATTRS:
+            # any-receiver memory probes (dev.memory_stats(),
+            # compiled.memory_analysis()) and jax.live_arrays()
+            self._emit(
+                "hot-sync", node.lineno, node.col_offset, qual,
+                f".{f.attr}() polls memory inside the per-step dispatch "
+                f"path — sample at step boundaries via mxnet_tpu.memwatch "
+                f"(on_step/on_checkpoint) instead")
+            return
+        if _is_module_call(node, self.scopes, "jax", "live_arrays"):
+            # from-import form: `from jax import live_arrays`
+            self._emit(
+                "hot-sync", node.lineno, node.col_offset, qual,
+                "jax.live_arrays() walks every live buffer inside the "
+                "per-step dispatch path — sample at step boundaries via "
+                "mxnet_tpu.memwatch instead")
             return
         if _is_module_call(node, self.scopes, "numpy", "asarray"):
             arg = node.args[0] if node.args else None
