@@ -126,6 +126,30 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "parallel/async_loss.py; honored by DataParallelStep.step (lazy "
         "AsyncLoss), gluon Trainer.step and module.Module.update (step "
         "fences)"),
+    # superstep compiled training + AOT executable cache
+    # (docs/PERFORMANCE.md §Superstep & AOT executable cache)
+    "MX_SUPERSTEP": (
+        "honored", "transparent superstep group size K: every K "
+        "DataParallelStep.step() calls dispatch as ONE compiled "
+        "lax.scan over the step program (per-step lr/RNG become scanned "
+        "arrays; losses return as lazy per-step views).  0/unset = off; "
+        "defaults off on CPU meshes regardless of K — XLA:CPU runs scan "
+        "bodies ~4.7x slower (parallel/data_parallel.py superstep_k)"),
+    "MX_SUPERSTEP_FORCE_CPU": (
+        "honored", "1 overrides the CPU-mesh gate of MX_SUPERSTEP (the "
+        "CPU parity-test/bench override; production CPU meshes should "
+        "leave it off — see the MX_SUPERSTEP caveat)"),
+    "MX_EXECUTABLE_CACHE_DIR": (
+        "honored", "directory of the persistent AOT executable cache: "
+        "DataParallelStep/FusedUpdater jit sites lower ahead-of-time and "
+        "serialize the compiled program here, keyed by "
+        "(memwatch.fingerprint, jax version, platform, mesh shape); a "
+        "restarted process deserializes instead of recompiling "
+        "(aot_cache.py).  Unset = no persistence"),
+    "MX_EXECUTABLE_CACHE": (
+        "honored", "0 kills all AOT executable persistence even when "
+        "MX_EXECUTABLE_CACHE_DIR is set — no loads, no stores, plain "
+        "jit dispatch (aot_cache.enabled)"),
     # runtime telemetry (docs/OBSERVABILITY.md)
     "MX_TELEMETRY_DIR": (
         "honored", "enables the telemetry recorder: one rank-<R>.jsonl "

@@ -593,9 +593,19 @@ class DevicePrefetchIter(_ThreadedIter):
 
     Only the FIRST label array is staged (the fused step consumes one
     label); extra label arrays pass through untouched.
+
+    ``depth=None`` (default) sizes the staging queue automatically: 1
+    normally, or the superstep group size when ``MX_SUPERSTEP`` is
+    active on this step's mesh — a K-step scan dispatch consumes K
+    staged batches at once, and a depth-1 queue would stall the group
+    fill behind each step's H2D.
     """
 
-    def __init__(self, data_iter, step, depth=1):
+    def __init__(self, data_iter, step, depth=None):
+        if depth is None:
+            from ..parallel.data_parallel import superstep_k
+
+            depth = max(1, superstep_k(getattr(step, "mesh", None)))
         self._step = step
         self._QUEUE_DEPTH = max(1, int(depth))
         super().__init__(data_iter,
@@ -622,16 +632,23 @@ class DevicePrefetchIter(_ThreadedIter):
         self._step.drain()
 
 
-def stage_batches(iterable, step, depth=1):
+def stage_batches(iterable, step, depth=None):
     """Generator wrapper giving any (data, ..., label)-tuple iterable —
     e.g. a ``gluon.data.DataLoader`` — the same background device staging
     as :class:`DevicePrefetchIter`: each batch's arrays are pre-placed
     onto ``step``'s input shardings in a worker thread while the previous
     step computes.  Batches that are a single array stage as data only;
     sequences stage all-but-last as data and the last element as label.
-    The step's in-flight window is drained when the iterable ends."""
+    The step's in-flight window is drained when the iterable ends.
+    ``depth=None`` auto-sizes to the superstep group size like
+    :class:`DevicePrefetchIter`."""
     import queue as _q
     import threading
+
+    if depth is None:
+        from ..parallel.data_parallel import superstep_k
+
+        depth = max(1, superstep_k(getattr(step, "mesh", None)))
 
     q: "_q.Queue" = _q.Queue(maxsize=max(1, int(depth)))
     _END, _ERR = object(), object()

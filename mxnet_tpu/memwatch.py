@@ -135,6 +135,7 @@ class _State:
         self.compile_seen: set = set()
         self.compiles: List[dict] = []
         self.compile_ms = 0.0
+        self.compile_cache_hits = 0
         self.oom_reported = False
 
 
@@ -488,9 +489,14 @@ def note_compile(executor: str, parts: Any, wall_s: float, site: str = "",
     event per (executor, fingerprint) — a steady-state step re-calling
     the cached executable never re-emits — carrying the compile wall
     (the traced first call's wall, per the record_step convention) and
-    whatever analysis this jax exposes.  Returns the fingerprint (None
-    when the watchdog is off — ``MX_MEMWATCH=0`` kills compile
-    accounting, including the analysis retrace, along with sampling)."""
+    whatever analysis this jax exposes.  AOT-cache facts ride in
+    ``extra``: ``cache_hit=True`` + ``deserialize_ms`` mark an
+    executable loaded from the persistent cache (mxnet_tpu.aot_cache)
+    instead of compiled — tools/mem_report.py's executable table shows
+    them so a post-mortem distinguishes "loaded in 0.2s" from "compiled
+    in 40s".  Returns the fingerprint (None when the watchdog is off —
+    ``MX_MEMWATCH=0`` kills compile accounting, including the analysis
+    retrace, along with sampling)."""
     if not enabled():
         return None
     fp = fingerprint(parts)
@@ -509,6 +515,8 @@ def note_compile(executor: str, parts: Any, wall_s: float, site: str = "",
             pass
     with _state.lock:
         _state.compile_ms += wall_s * 1e3
+        if ev.get("cache_hit"):
+            _state.compile_cache_hits += 1
         _state.compiles.append(dict(ev))
         if len(_state.compiles) > _COMPILE_RECORDS_MAX:
             del _state.compiles[:-_COMPILE_RECORDS_MAX]
@@ -611,6 +619,7 @@ def summary() -> dict:
                      "category": _state.leak_category,
                      "events": _state.leak_events},
             "compiles": {"count": len(_state.compile_seen),
-                         "wall_ms": round(_state.compile_ms, 3)},
+                         "wall_ms": round(_state.compile_ms, 3),
+                         "cache_hits": _state.compile_cache_hits},
             "oom_reported": _state.oom_reported,
         }

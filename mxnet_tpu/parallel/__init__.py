@@ -8,8 +8,9 @@ ICI/DCN (SURVEY §2.4, §5.8).
 """
 from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
                    global_allreduce)
-from .async_loss import AsyncLoss, InflightRing, drain_all, inflight_limit
-from .data_parallel import DataParallelStep, make_train_step
+from .async_loss import (AsyncLoss, InflightRing, StackedAsyncLoss,
+                         SuperstepLossView, drain_all, inflight_limit)
+from .data_parallel import DataParallelStep, make_train_step, superstep_k
 from .ring import ring_attention, ring_self_attention
 from .ulysses import ulysses_self_attention
 from .pipeline import pipeline_apply

@@ -50,8 +50,8 @@ from .. import memwatch
 from .. import telemetry
 from ..base import MXNetError
 
-__all__ = ["AsyncLoss", "StepFence", "InflightRing", "inflight_limit",
-           "drain_all"]
+__all__ = ["AsyncLoss", "StackedAsyncLoss", "SuperstepLossView",
+           "StepFence", "InflightRing", "inflight_limit", "drain_all"]
 
 _DEFAULT_INFLIGHT = 2
 
@@ -84,6 +84,10 @@ class _PendingHandle:
         self._forced = False
         self._host = None
         self._exc: Optional[BaseException] = None
+        # superstep views delegate their wait to the group handle, which
+        # records the blocked wall itself — the view must not re-record
+        # the same interval into the rollup
+        self._record_wait = True
 
     @property
     def step(self) -> int:
@@ -143,8 +147,9 @@ class _PendingHandle:
                 # all host time spent blocked on the device funnels into
                 # one per-executor rollup
                 # (summary()['steps'][name]['block_wait_ms'])
-                telemetry.record_block_wait(self._executor,
-                                            time.perf_counter() - t0)
+                if self._record_wait:
+                    telemetry.record_block_wait(self._executor,
+                                                time.perf_counter() - t0)
 
     def __repr__(self):
         state = "forced" if self._forced else "pending"
@@ -189,6 +194,59 @@ class AsyncLoss(_PendingHandle):
     def __array__(self, dtype=None, *args, **kwargs):
         out = self.asnumpy()
         return out if dtype is None else out.astype(dtype)
+
+
+class StackedAsyncLoss(AsyncLoss):
+    """Lazy (K,) vector of per-step losses from ONE superstep dispatch
+    (``DataParallelStep.superstep`` — K training steps inside a single
+    compiled ``lax.scan``).  One handle flows through the in-flight ring
+    per superstep, so the window bounds dispatched *supersteps*.
+
+    ``asnumpy()`` / ``np.asarray()`` force the readback and return the
+    full (K,) loss vector in step order; scalar conversions
+    (``float()`` / ``.asscalar()`` / ``.item()``) return the LAST step's
+    loss — exactly the value a sequential training loop would hold in
+    ``loss`` after the same K steps (what Speedometer-style display
+    callbacks want)."""
+
+    def __init__(self, value, steps, executor: str,
+                 ring: Optional["InflightRing"] = None, host_fn=None):
+        steps = tuple(int(s) for s in steps)
+        super().__init__(value, step=steps[-1], executor=executor,
+                         ring=ring, host_fn=host_fn)
+        self._steps = steps
+
+    @property
+    def steps(self):
+        """The step numbers this superstep covered, in dispatch order."""
+        return self._steps
+
+    def __len__(self):
+        return len(self._steps)
+
+    def asscalar(self):
+        return float(np.asarray(self.wait()).ravel()[-1])
+
+
+class SuperstepLossView(AsyncLoss):
+    """Per-step scalar view into a (possibly not-yet-dispatched)
+    superstep group — what ``DataParallelStep.step()`` returns in
+    transparent superstep mode so existing training loops keep their
+    one-loss-per-batch contract.  Forcing a view dispatches its group if
+    still buffered (a partial group runs as a shorter scan) and reads
+    this step's slot out of the stacked loss vector."""
+
+    def __init__(self, idx: int, step: int, executor: str, dispatch_fn):
+        super().__init__(None, step=step, executor=executor, ring=None)
+        self._idx = int(idx)
+        self._dispatch_fn = dispatch_fn
+        # the group's StackedAsyncLoss records the blocked wall once
+        self._record_wait = False
+
+    def _force(self):
+        stacked = self._dispatch_fn()
+        arr = np.asarray(stacked.wait(_span=False))
+        return arr.ravel()[self._idx]
 
 
 class StepFence(_PendingHandle):

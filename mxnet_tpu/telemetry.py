@@ -1076,6 +1076,8 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
                   kind="counter")
             gauge("mx_mem_compile_ms_total", ms["compiles"]["wall_ms"],
                   kind="counter")
+            gauge("mx_mem_compile_cache_hits_total",
+                  ms["compiles"].get("cache_hits", 0), kind="counter")
     except Exception:  # the snapshot must land even if memwatch breaks
         pass
     lines.append("# EOF")

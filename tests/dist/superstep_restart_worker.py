@@ -1,0 +1,143 @@
+"""Superstep + AOT-cache restart worker (docs/PERFORMANCE.md §Superstep
+& AOT executable cache): a supervised kill-and-restart must resume
+bitwise-identical with a WARM executable cache — the restarted
+incarnation deserializes its step/scan programs instead of recompiling.
+
+Phase baseline (MX_SSR_PHASE=baseline): uninterrupted 40-step run in
+transparent superstep mode (MX_SUPERSTEP=4, forced on for this CPU box);
+each rank writes its final weights as its own baseline.
+
+Phase supervised (MX_SSR_PHASE=supervised): same training under
+``tools/launch.py --max-restarts 1`` with a shared
+MX_EXECUTABLE_CACHE_DIR.  Rank 1 self-kills at step 24 on incarnation 0
+(past the step-20 checkpoint); the supervisor re-spawns the gang, each
+rank resumes from its latest valid checkpoint, and asserts:
+
+  * incarnation 1 booked AOT cache HITS for its DataParallelStep
+    executables (zero fresh scan/step compiles — the restart-SLO win);
+  * final weights are BITWISE identical to the uninterrupted baseline
+    (superstep group boundaries re-align because the checkpoint cadence
+    is a multiple of K, and the scan executable family is bitwise
+    self-consistent across lengths).
+
+Ranks train independent replicas on LOCAL single-device meshes (the
+oom_worker pattern — each rank pins one virtual CPU device before jax
+init), so the supervisor machinery, not cross-rank collectives, is what
+this worker exercises.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# one virtual CPU device BEFORE jax init: the pytest parent exports
+# XLA_FLAGS=8 which would leave 8 devices in every rank
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, gluon, memwatch, nd, telemetry
+from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+TOTAL_STEPS = 40
+SAVE_EVERY = 20  # multiple of MX_SUPERSTEP=4: group boundaries re-align
+KILL_STEP = 24
+
+
+def build():
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def main():
+    import jax
+
+    phase = os.environ["MX_SSR_PHASE"]
+    base = os.environ["MX_SSR_DIR"]
+    rank = int(os.environ.get("MX_PROC_ID", "0"))
+    restart = int(os.environ.get("MX_RESTART_COUNT", "0"))
+    ckdir = os.path.join(base, phase, f"rank{rank}")
+    telemetry.enable(os.path.join(base, phase, "tele"))
+
+    rng = np.random.RandomState(rank)
+    batches = [(rng.rand(8, 16).astype(np.float32),
+                rng.rand(8, 4).astype(np.float32)) for _ in range(8)]
+
+    net = build()
+    start = checkpoint.restore(ckdir, net)
+    if phase == "supervised" and restart == 1:
+        # rank 1 died at step 24, past its step-20 checkpoint; rank 0
+        # runs independently and may have finished (checkpoint 40)
+        # before the gang teardown reached it
+        expect = (SAVE_EVERY,) if rank == 1 else (SAVE_EVERY, TOTAL_STEPS)
+        assert start in expect, f"rank {rank}: resume at {start}"
+        print(f"rank {rank}: incarnation 1 resuming at step {start}",
+              flush=True)
+
+    # momentum=0: the SGD update is stateless, so params alone make the
+    # checkpoint complete and the resumed trajectory bitwise-exact.
+    # local_devices: under the gang rendezvous jax.devices() is GLOBAL —
+    # rank 1 must mesh over its own device, not rank 0's
+    step = DataParallelStep(
+        net, gluon.loss.L2Loss(),
+        mesh=local_mesh(devices=[jax.local_devices()[0]]), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.0})
+
+    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=SAVE_EVERY,
+                                        keep=2, initial_step=start)
+    for i in range(start, TOTAL_STEPS):
+        x, y = batches[i % len(batches)]
+        step.step(nd.array(x), nd.array(y))
+        step_no = i + 1
+        if step_no % SAVE_EVERY == 0:
+            # land the group + write params back into the gluon block so
+            # the checkpoint snapshots step_no's true state
+            step.sync_to_block()
+        ckpt.step(net)
+        if (phase == "supervised" and restart == 0 and rank == 1
+                and step_no == KILL_STEP):
+            step.drain()
+            ckpt.wait()
+            print(f"rank {rank}: self-kill at step {step_no}", flush=True)
+            telemetry.flush()
+            os._exit(43)
+    ckpt.close()
+    if step.params is not None:
+        step.sync_to_block()
+
+    comps = memwatch.summary()["compiles"]
+    print(f"rank {rank}: incarnation {restart} compiles={comps['count']} "
+          f"cache_hits={comps['cache_hits']}", flush=True)
+    if (phase == "supervised" and restart == 1
+            and start < TOTAL_STEPS):
+        # the warm-cache contract: a restarted incarnation that actually
+        # trained deserialized its scan executable instead of recompiling
+        # (a rank that already finished before the gang died resumes at
+        # TOTAL_STEPS and never dispatches)
+        assert comps["cache_hits"] >= 1, comps
+        print(f"rank {rank}: warm-cache restart OK", flush=True)
+
+    w = np.concatenate([p.data().asnumpy().ravel()
+                        for _n, p in sorted(net.collect_params().items())])
+    wpath = os.path.join(base, f"final-rank{rank}.npy")
+    if phase == "baseline":
+        np.save(wpath, w)
+        print(f"rank {rank}: baseline OK", flush=True)
+    else:
+        baseline = np.load(wpath)
+        assert np.array_equal(baseline, w), (
+            f"rank {rank}: resumed weights differ from baseline "
+            f"(max abs {np.max(np.abs(baseline - w))})")
+        print(f"rank {rank}: matches uninterrupted baseline", flush=True)
+    telemetry.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -271,6 +271,43 @@ def test_stale_hot_entry_is_a_finding(tmp_path):
     assert "Step._step_impl" in findings[0].message
 
 
+def test_superstep_entries_registered_and_rename_fails_loudly(tmp_path):
+    """The superstep dispatch/scan-body qualnames are in the REAL
+    HOT_PATH_ENTRIES (the new hottest path must stay under the hot-sync
+    rule), and renaming the scan-body builder in a fixture carrying
+    those entries flags stale-hot-entry rather than silently un-linting
+    the path."""
+    real = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/parallel/data_parallel.py"]
+    assert "DataParallelStep._superstep_impl" in real
+    assert "DataParallelStep._super_fn" in real
+
+    entries = {"mxnet_tpu/fixture.py": ("DataParallelStep._superstep_impl",
+                                        "DataParallelStep._super_fn")}
+    findings, _ = lint_src(tmp_path, """
+        class DataParallelStep:
+            def _superstep_impl(self, group):
+                return group
+
+            def _super_fn_renamed(self, k):
+                return k
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["stale-hot-entry"]
+    assert "DataParallelStep._super_fn" in findings[0].message
+    # a host readback reachable from the superstep dispatch body is
+    # flagged like any hot path
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class DataParallelStep:
+            def _superstep_impl(self, group):
+                return np.asarray(group)
+
+            def _super_fn(self, k):
+                return k
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+
+
 # ---------------------------------------------------------------------------
 # signal-unsafe
 # ---------------------------------------------------------------------------

@@ -78,7 +78,11 @@ RULES = {
 # analysis starts here (repo-relative path -> function qualnames)
 HOT_PATH_ENTRIES = {
     "mxnet_tpu/parallel/data_parallel.py": (
-        "DataParallelStep._step_impl", "DataParallelStep.stage"),
+        "DataParallelStep._step_impl", "DataParallelStep.stage",
+        # superstep mode: the group dispatch body and the scan-body
+        # builder (its nested lax.scan body is the hottest path in the
+        # tree — K steps per dispatch ride through it)
+        "DataParallelStep._superstep_impl", "DataParallelStep._super_fn"),
     "mxnet_tpu/optimizer/fused.py": ("FusedUpdater._apply_impl",),
     "mxnet_tpu/parallel/async_loss.py": (
         "InflightRing.make_room", "InflightRing.admit",
