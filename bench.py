@@ -335,6 +335,21 @@ def main():
             result["pipeline_overlap"] = pipe
             print(json.dumps(result), flush=True)
 
+    # serving_throughput: continuous batching + paged KV decode vs
+    # sequential per-request decode on a mixed-length synthetic request
+    # trace (docs/SERVING.md).  Host-dispatch-bound on the tiny model, so
+    # it measures on CPU; the batching win is the point (>= 1.5x).
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_SERVING", "1") != "0"
+            and "error" not in result):
+        srv = _run_child("cpu", float(os.environ.get(
+            "BENCH_SERVING_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "serving_throughput"})
+        if srv is not None:
+            srv.pop("probe_history", None)
+            result["serving_throughput"] = srv
+            print(json.dumps(result), flush=True)
+
     # telemetry_overhead: steps/sec with the recorder + span tracing ON vs
     # fully off — the "observability must be cheap enough to leave on"
     # claim (docs/OBSERVABILITY.md §Tracing) measured, not asserted.
@@ -803,6 +818,102 @@ def bench_pipeline_overlap(platform):
     }))
 
 
+def bench_serving_throughput(platform):
+    """Secondary metric: the continuous-batching win — tokens/sec through
+    the serving engine (S slots, paged KV cache, ONE compiled decode
+    step shared by ragged in-flight requests) vs sequential per-request
+    decode: one ``translate(beam_size=1)`` call per request, the status
+    quo this subsystem replaces (ISSUE/ROADMAP item 1).  The slots=1
+    engine rides along as ``engine_slots1_tokens_per_sec``, isolating
+    the pure batching share of the win from the compiled-single-step
+    share.  Mixed-length synthetic request trace with mid-flight
+    arrivals; interleaved trials compared by interquartile mean (this
+    box drifts 2x at sub-second scale — the telemetry_overhead
+    estimator).  Values well above 1 are the point (docs/SERVING.md)."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+    slots = int(os.environ.get("BENCH_SERVING_SLOTS", 8))
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", 16))
+    trials = int(os.environ.get("BENCH_SERVING_TRIALS", 4))
+    max_len = 40
+
+    mx.random.seed(0)
+    net = Transformer(64, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=64, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(3, 64, 8).astype(np.int32) for _ in range(n_req)]
+    # mixed decode lengths (7..33) — the ragged trace continuous
+    # batching exists for (eos_id=1: never emitted, length-capped)
+    lens = (7 + (np.arange(n_req) * 11) % 27).astype(int)
+    arrivals = [0 if i < slots else int(i) for i in range(n_req)]
+
+    def build(n_slots):
+        eng = ServingEngine(TransformerAdapter(net, src_max_len=8),
+                            slots=n_slots, page_size=8, max_len=max_len,
+                            stream_every=4, ctx=ctx)
+        # warm the compiled decode + prefill before timing
+        eng.serve([Request(prompts[0], 4, bos_id=2, eos_id=1)])
+        return eng
+
+    def run_trial(eng, batched):
+        reqs = [Request(prompts[i], int(lens[i]), bos_id=2, eos_id=1)
+                for i in range(n_req)]
+        t0 = time.perf_counter()
+        eng.serve(reqs, arrival_steps=arrivals if batched else None)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.stream) for r in reqs)
+        return toks / wall
+
+    from mxnet_tpu import nd
+
+    src_nds = [nd.array(p.reshape(1, -1), dtype="int32") for p in prompts]
+
+    def run_translate_trial():
+        # the status quo: one standalone greedy translate per request
+        t0 = time.perf_counter()
+        toks = 0
+        for i in range(n_req):
+            out = net.translate(src_nds[i], bos_id=2, eos_id=1,
+                                max_len=int(lens[i]) + 1, beam_size=1)
+            toks += out.shape[1] - 1
+        return toks / (time.perf_counter() - t0)
+
+    def iq_mean(vals):
+        vals = sorted(vals)
+        k = max(1, len(vals) // 4)
+        core = vals[k:-k] if len(vals) > 2 * k else vals
+        return sum(core) / len(core)
+
+    eng_b = build(slots)
+    eng_s = build(1)
+    run_translate_trial()  # warm translate's eager op cache
+    cont, seq, s1 = [], [], []
+    for _ in range(trials):  # interleave: box drift hits all modes alike
+        cont.append(run_trial(eng_b, batched=True))
+        seq.append(run_translate_trial())
+        s1.append(run_trial(eng_s, batched=False))
+    cont_tps, seq_tps = iq_mean(cont), iq_mean(seq)
+    print(json.dumps({
+        "metric": "serving_throughput",
+        "value": round(cont_tps / seq_tps, 3) if seq_tps else 0.0,
+        "unit": "x_continuous_vs_sequential",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "continuous_tokens_per_sec": round(cont_tps, 2),
+        "sequential_tokens_per_sec": round(seq_tps, 2),
+        "engine_slots1_tokens_per_sec": round(iq_mean(s1), 2),
+        "slots": slots, "requests": n_req,
+        "decode_lengths": [int(x) for x in lens],
+        "trials": trials,
+    }))
+
+
 def bench_telemetry_overhead(platform):
     """Secondary metric: steady-state steps/sec with the telemetry
     recorder + span tracing enabled (MX_TELEMETRY_DIR set, spans on — the
@@ -1047,6 +1158,8 @@ def child_main(platform):
         bench_trainer_overhead(platform)
     elif model == "pipeline_overlap":
         bench_pipeline_overlap(platform)
+    elif model == "serving_throughput":
+        bench_serving_throughput(platform)
     elif model == "telemetry_overhead":
         bench_telemetry_overhead(platform)
     elif model == "memwatch_overhead":

@@ -150,6 +150,36 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "honored", "0 kills all AOT executable persistence even when "
         "MX_EXECUTABLE_CACHE_DIR is set — no loads, no stores, plain "
         "jit dispatch (aot_cache.enabled)"),
+    # inference serving: continuous batching + paged KV cache
+    # (docs/SERVING.md)
+    "MX_SERVE_SLOTS": (
+        "honored", "fixed decode-slot count of the serving engine — the "
+        "in-flight batch width of the ONE compiled decode step (default "
+        "8; serving/engine.py ServingEngine)"),
+    "MX_SERVE_PAGE_SIZE": (
+        "honored", "tokens per KV-cache page (default 16): the paged "
+        "pool granularity requests allocate/free in "
+        "(serving/paged_cache.py)"),
+    "MX_SERVE_POOL_PAGES": (
+        "honored", "total pages in the per-layer KV pools (default 0 = "
+        "auto: slots * ceil(max_len/page_size) + 1, every slot can reach "
+        "max_len); the engine raises when active requests exhaust it "
+        "(serving/engine.py _ensure_pages)"),
+    "MX_SERVE_QUEUE": (
+        "honored", "request-queue bound (default 256; 0 = unbounded): a "
+        "full queue rejects submits loudly — the serving backpressure "
+        "surface (serving/scheduler.py)"),
+    "MX_SERVE_STREAM_EVERY": (
+        "honored", "decode steps per stream boundary (default 4): token "
+        "readback, EOS eviction and mid-flight admission happen at this "
+        "cadence — the host never blocks per token "
+        "(serving/engine.py)"),
+    "MX_SERVE_FLASH": (
+        "honored", "paged-attention path: 'auto' (default) fuses through "
+        "the Pallas ragged paged kernel only where it compiles natively "
+        "(TPU), 1 forces it (interpret-mode tests), 0 pins the XLA "
+        "gather path — the bitwise-parity path "
+        "(serving/engine.py _serve_fused)"),
     # runtime telemetry (docs/OBSERVABILITY.md)
     "MX_TELEMETRY_DIR": (
         "honored", "enables the telemetry recorder: one rank-<R>.jsonl "

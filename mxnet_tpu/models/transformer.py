@@ -23,8 +23,8 @@ from ..gluon.block import HybridBlock
 __all__ = ["MultiHeadAttention", "MultiHeadCrossAttention", "PositionwiseFFN",
            "TransformerEncoderCell", "TransformerEncoder",
            "PositionalEmbedding", "TransformerDecoderCell",
-           "TransformerDecoder", "Transformer", "transformer_base",
-           "transformer_big", "label_smoothed_ce"]
+           "TransformerDecoder", "Transformer", "DenseStepCache",
+           "transformer_base", "transformer_big", "label_smoothed_ce"]
 
 
 def _split_heads(t, num_heads, head_dim):
@@ -278,13 +278,15 @@ class TransformerDecoderCell(HybridBlock):
         x = self.ln2(x + self.drop(self.cross_attn(x, mem, cross_mask)))
         return self.ln3(x + self.ffn(x))
 
-    def step(self, F, x_t, mem, cross_mask_t, K, V, keep, t):
+    def step(self, F, x_t, mem, cross_mask_t, cache):
         """Incremental decode of ONE position with cached self-attn K/V.
 
-        x_t: (B, 1, C); K/V: fixed (B, Lmax, C) caches (this position's
-        k/v are written at row t); keep: (B, Lmax), 1 for rows <= t.
-        Returns (y_t, K, V).  Inference-only (dropout is identity outside
-        autograd.record)."""
+        x_t: (B, 1, C); ``cache`` is this layer's step-cache object
+        (:class:`DenseStepCache`, or ``serving.paged_cache.
+        PagedStepCache`` for the paged pool): it writes this position's
+        k/v and attends the query over every row written so far.
+        Returns y_t; the updated cache state stays on the cache object.
+        Inference-only (dropout is identity outside autograd.record)."""
         sa = self.self_attn
         if self._pre_norm:
             h = self.ln1(x_t)
@@ -292,17 +294,14 @@ class TransformerDecoderCell(HybridBlock):
             h = x_t
         qkv = sa.qkv(h)
         q_t, k_t, v_t = F.split(qkv, num_outputs=3, axis=-1)
-        K[:, t:t + 1] = k_t
-        V[:, t:t + 1] = v_t
-        a = sa.proj(_attend_cached(F, q_t, K, V, keep,
-                                   sa._num_heads, sa._head_dim))
+        a = sa.proj(cache.update_and_attend(F, sa, q_t, k_t, v_t))
         if self._pre_norm:
             x = x_t + a
             x = x + self.cross_attn(self.ln2(x), mem, cross_mask_t)
-            return x + self.ffn(self.ln3(x)), K, V
+            return x + self.ffn(self.ln3(x))
         x = self.ln1(x_t + a)
         x = self.ln2(x + self.cross_attn(x, mem, cross_mask_t))
-        return self.ln3(x + self.ffn(x)), K, V
+        return self.ln3(x + self.ffn(x))
 
 
 class TransformerDecoder(HybridBlock):
@@ -336,6 +335,28 @@ def _attend_cached(F, q_t, K, V, keep, num_heads, head_dim):
     attn = F.softmax(scores, axis=-1)
     out = F.batch_dot(attn, v)                        # (B*H, 1, hd)
     return _merge_heads(out, num_heads)               # (B, 1, C)
+
+
+class DenseStepCache:
+    """Per-layer dense (B, Lmax, C) K/V decode cache (the seed design):
+    this position's k/v are written at the host-known row ``t``, and
+    validity is the ``keep`` mask (B, Lmax), 1 = attend.
+
+    Kept as the bitwise reference for the paged cache
+    (``mxnet_tpu.serving.paged_cache``): the serving parity tests assert
+    paged decode == dense decode for the same tokens, and anything that
+    only needs a single fixed-length sequence can keep using it."""
+
+    def __init__(self, K, V, keep, t):
+        self.K, self.V, self.keep = K, V, keep
+        self.t = int(t)
+
+    def update_and_attend(self, F, attn, q_t, k_t, v_t):
+        t = self.t
+        self.K[:, t:t + 1] = k_t
+        self.V[:, t:t + 1] = v_t
+        return _attend_cached(F, q_t, self.K, self.V, self.keep,
+                              attn._num_heads, attn._head_dim)
 
 
 class Transformer(HybridBlock):
@@ -413,19 +434,23 @@ class Transformer(HybridBlock):
         mem, src_keep = self._encode_h(F, src)
         return self._decode_h(F, tgt, mem, src_keep)
 
-    def _decode_step(self, F, tok_t, t, mem, src_keep, caches, keep):
-        """Logits (B, V) for one decode position using per-layer KV caches
-        (see TransformerDecoderCell.step).  Inference-only."""
+    def _decode_step(self, F, tok_t, pos, mem, src_keep, caches):
+        """Logits (B, V) for one decode position using per-layer step
+        caches (see TransformerDecoderCell.step).  Inference-only.
+
+        ``pos`` is an int32 NDArray of per-row decode positions — (B,)
+        for the serving engine's ragged slots, (1,) broadcasting one
+        uniform position for ``translate``.  A device position (gather,
+        not slice) keeps the step program shape-stable across positions:
+        one executable decodes every t, the serving engine's
+        no-per-length-retrace contract."""
         ctx = tok_t.context
         x = self.embed(tok_t) * math.sqrt(self._units)  # (B, 1, C)
-        pos_row = F.slice_axis(self.pos.weight.data(ctx), axis=0,
-                               begin=t, end=t + 1)
-        x = F.broadcast_add(x, pos_row.expand_dims(0))
+        pos_rows = F.take(self.pos.weight.data(ctx), pos, axis=0)  # (n, C)
+        x = F.broadcast_add(x, pos_rows.expand_dims(1))
         cross_mask_t = src_keep.expand_dims(1)  # (B, 1, Ts)
-        for i, cell in enumerate(self.decoder.layers):
-            K, V = caches[i]
-            x, K, V = cell.step(F, x, mem, cross_mask_t, K, V, keep, t)
-            caches[i] = (K, V)
+        for cell, cache in zip(self.decoder.layers, caches):
+            x = cell.step(F, x, mem, cross_mask_t, cache)
         if self._tie:
             return F.FullyConnected(x.reshape(0, -1),
                                     self.embed.weight.data(ctx),
@@ -434,106 +459,182 @@ class Transformer(HybridBlock):
 
     # -- inference ---------------------------------------------------------
     def translate(self, src, bos_id, eos_id, max_len=32, beam_size=4,
-                  alpha=0.6, incremental=True):
+                  alpha=0.6, incremental=True, sync_every=8,
+                  page_size=None):
         """Beam-search decode (GNMT length penalty).
 
         src: NDArray (B, Ts) int.  Returns (B, max_len) numpy int32 of the
         best hypotheses (eos/pad-trimmed by the caller).  The encoder runs
         ONCE.  With incremental=True (default) the per-step scorer is a
-        single-position decoder over fixed-size per-layer KV caches —
-        O(L) per step, one executable reused every step; incremental=False
-        re-decodes the full padded prefix (O(L^2) per step, the
-        cross-check path).  Beam bookkeeping is host-side numpy, as in
-        the reference's BeamSearchSampler.
-        """
+        single-position decoder over the **paged KV cache**
+        (mxnet_tpu.serving.paged_cache; beam slots own statically
+        assigned page runs, beam reorders gather page contents) — O(L)
+        per step, one executable family reused every step;
+        incremental=False re-decodes the full padded prefix (O(L^2) per
+        step, the cross-check path).
+
+        Beam bookkeeping lives ON DEVICE (log-softmax, top-k, beam
+        gather, EOS tracking are NDArray ops): no per-token host
+        readback — the host reads one finished-count scalar every
+        ``sync_every`` steps for early exit and the final state once at
+        the end, so the dispatch pipeline never blocks per token (the
+        serving-engine contract; docs/SERVING.md)."""
         from .. import autograd
         from .. import ndarray as F
         import numpy as _np
 
         B, Ts = src.shape
         K, V = beam_size, self._vocab
+        BK = B * K
+        ctx = src.context
+        if max_len > self.pos._max_length:
+            # the device position lookup is a gather (mode='clip'):
+            # out-of-table positions would silently repeat the last
+            # embedding row instead of failing
+            raise MXNetError(
+                f"max_len {max_len} > positional table "
+                f"{self.pos._max_length}; build the model with a larger "
+                "max_length")
         src_np = _np.asarray(src.asnumpy(), _np.int32)
         from ..ndarray import array as nd_array
 
         with autograd.pause():
             # encode the (B, Ts) batch ONCE, then tile memory for beams —
             # 1/K the encoder FLOPs of encoding the repeated batch
-            src_1 = nd_array(src_np, ctx=src.context, dtype="int32")
+            src_1 = nd_array(src_np, ctx=ctx, dtype="int32")
             mem, src_keep = self._encode_h(F, src_1)
             mem = F.repeat(mem, repeats=K, axis=0)          # (B*K, Ts, C)
             src_keep = F.repeat(src_keep, repeats=K, axis=0)  # (B*K, Ts)
-        tgt = _np.full((B * K, max_len), self._pad_id, _np.int32)
-        tgt[:, 0] = bos_id
-        scores = _np.full((B, K), -_np.inf, _np.float32)
-        scores[:, 0] = 0.0  # only beam 0 live at t=0 (all beams identical)
-        finished = _np.zeros((B, K), bool)
 
-        caches = None
-        if incremental:
-            from ..ndarray import zeros as nd_zeros
+            # device-resident beam state
+            tgt = nd_array(_np.full((BK, max_len), self._pad_id, _np.int32),
+                           ctx=ctx, dtype="int32")
+            tgt[:, 0] = bos_id
+            last_tok = nd_array(_np.full((BK, 1), bos_id, _np.int32),
+                                ctx=ctx, dtype="int32")
+            s0 = _np.full((B, K), -_np.inf, _np.float32)
+            s0[:, 0] = 0.0  # only beam 0 live at t=0 (all beams identical)
+            scores = nd_array(s0, ctx=ctx)
+            finished = nd_array(_np.zeros((B, K), _np.float32), ctx=ctx)
+            # finished beams only extend with pad at zero cost
+            lp0 = _np.full((1, 1, V), -_np.inf, _np.float32)
+            lp0[..., self._pad_id] = 0.0
+            lp_fin = nd_array(lp0, ctx=ctx)
+            # constant index helpers, created once: every per-step update
+            # below is value-only, so each eager op reuses ONE cached
+            # executable instead of respecializing per position
+            col_iota = nd_array(_np.arange(max_len, dtype=_np.int32)[None],
+                                ctx=ctx, dtype="int32")
+            b_off = nd_array((_np.arange(B, dtype=_np.int32) * K)[:, None],
+                             ctx=ctx, dtype="int32")
+            eos_nd = nd_array(_np.array([[eos_id]], _np.int32), ctx=ctx,
+                              dtype="int32")
+            pad_nd = nd_array(_np.array([[self._pad_id]], _np.int32),
+                              ctx=ctx, dtype="int32")
 
-            dt = mem.dtype
-            caches = [(nd_zeros((B * K, max_len, self._units), ctx=src.context,
-                                dtype=dt),
-                       nd_zeros((B * K, max_len, self._units), ctx=src.context,
-                                dtype=dt))
-                      for _ in range(len(self.decoder.layers))]
+            pools = None
+            if incremental:
+                from ..serving.paged_cache import (PagedKVCache,
+                                                   PagedStepCache,
+                                                   page_coords, pages_for)
 
-        for t in range(1, max_len):
-            with autograd.pause():
+                cell0 = self.decoder.layers[0].self_attn
+                H, hd = cell0._num_heads, cell0._head_dim
+                ps = int(page_size or min(16, max_len))
+                P = pages_for(max_len, ps)
+                cache = PagedKVCache(len(self.decoder.layers), BK * P + 1,
+                                     ps, H, hd, ctx=ctx,
+                                     dtype=_np.dtype(mem.dtype).name)
+                # static CONTIGUOUS slot-per-beam page runs (beam s owns
+                # pages [1+s*P, 1+(s+1)*P); page 0 stays the trash page):
+                # beam reorders below gather page contents by this layout
+                table = nd_array(
+                    1 + _np.arange(BK * P, dtype=_np.int32).reshape(BK, P),
+                    ctx=ctx, dtype="int32")
+                pools = [list(kv) for kv in cache.pools]
+                zero_page = nd_array(_np.zeros((1,), _np.int32), ctx=ctx,
+                                     dtype="int32")
+                Lp = P * ps
+                row_iota = nd_array(
+                    _np.broadcast_to(_np.arange(Lp, dtype=_np.float32),
+                                     (BK, Lp)).copy(), ctx=ctx)
+                page_off = nd_array(_np.arange(P, dtype=_np.int32)[None],
+                                    ctx=ctx, dtype="int32")
+
+            for t in range(1, max_len):
+                pos_nd = nd_array(_np.array([t - 1], _np.int32), ctx=ctx,
+                                  dtype="int32")
                 if incremental:
-                    keep = _np.zeros((B * K, max_len), _np.float32)
-                    keep[:, :t] = 1.0  # cache rows written so far incl. t-1
-                    step_logits = self._decode_step(
-                        F, nd_array(tgt[:, t - 1:t], ctx=src.context,
-                                    dtype="int32"),
-                        t - 1, mem, src_keep, caches,
-                        nd_array(keep, ctx=src.context))
+                    keep = F.broadcast_lesser(
+                        row_iota, nd_array(_np.array([[t]], _np.float32),
+                                           ctx=ctx))
+                    pages, rows = page_coords(table, pos_nd, ps)
+                    caches = [PagedStepCache(kp, vp, table, pages, rows,
+                                             keep)
+                              for kp, vp in pools]
+                    step_logits = self._decode_step(F, last_tok, pos_nd,
+                                                    mem, src_keep, caches)
+                    pools = [[c.k_pool, c.v_pool] for c in caches]
                 else:
-                    logits = self._decode_h(
-                        F, nd_array(tgt, ctx=src.context, dtype="int32"),
-                        mem, src_keep)
-                    # slice the one needed position on-device: the host
-                    # copy is (B*K, V), not (B*K, max_len, V)
+                    logits = self._decode_h(F, tgt, mem, src_keep)
+                    # slice the one needed position on-device
                     step_logits = F.slice_axis(logits, axis=1, begin=t - 1,
                                                end=t).reshape(0, -1)
-            lp = _np.asarray(step_logits.asnumpy(), _np.float32)  # (B*K, V)
-            lp = lp - _np.log(_np.exp(lp - lp.max(-1, keepdims=True)).sum(
-                -1, keepdims=True)) - lp.max(-1, keepdims=True)
-            lp = lp.reshape(B, K, V)
-            # finished beams only extend with pad at zero cost
-            lp_fin = _np.full((V,), -_np.inf, _np.float32)
-            lp_fin[self._pad_id] = 0.0
-            lp = _np.where(finished[:, :, None], lp_fin[None, None], lp)
-            cand = scores[:, :, None] + lp  # (B, K, V)
-            flat = cand.reshape(B, K * V)
-            top = _np.argsort(-flat, axis=1)[:, :K]  # (B, K)
-            scores = _np.take_along_axis(flat, top, axis=1)
-            beam_idx, tok = top // V, (top % V).astype(_np.int32)
-            new_tgt = _np.take_along_axis(tgt.reshape(B, K, max_len),
-                                          beam_idx[:, :, None], axis=1)
-            new_tgt[:, :, t] = tok
-            tgt = new_tgt.reshape(B * K, max_len)
-            if incremental and not (beam_idx
-                                    == _np.arange(K)[None, :]).all():
-                # the KV caches follow their beams (skipped when the
-                # permutation is identity — always true for beam_size=1)
-                flat = (_np.arange(B)[:, None] * K + beam_idx) \
-                    .reshape(-1).astype(_np.int32)
-                idx_nd = nd_array(flat, ctx=src.context, dtype="int32")
-                with autograd.pause():
-                    caches = [(F.take(Kc, idx_nd, axis=0),
-                               F.take(Vc, idx_nd, axis=0))
-                              for Kc, Vc in caches]
-            finished = _np.take_along_axis(finished, beam_idx, axis=1) \
-                | (tok == eos_id) | (tok == self._pad_id)
-            if finished.all():
-                break
+                lp = step_logits.log_softmax(axis=-1).reshape(B, K, V)
+                fin3 = F.broadcast_like(finished.expand_dims(2), lp,
+                                        lhs_axes=(2,), rhs_axes=(2,))
+                lpf3 = F.broadcast_like(lp_fin, lp, lhs_axes=(0, 1),
+                                        rhs_axes=(0, 1))
+                lp = F.where(fin3, lpf3, lp)
+                cand = F.broadcast_add(scores.expand_dims(2), lp)
+                scores, top = F.topk(cand.reshape(B, K * V), axis=1, k=K,
+                                     ret_typ="both", dtype="int32")
+                # beam parent / token split of the flat top-k indices
+                from ..ndarray import NDArray as _ND
+
+                beam_idx = _ND(top._data // V, ctx=ctx)       # (B, K)
+                tok = _ND((top._data % V).astype("int32"), ctx=ctx)
+                if K > 1:
+                    flat_parent = (b_off + beam_idx).reshape(-1)  # (BK,)
+                    tgt = F.take(tgt, flat_parent, axis=0)
+                    finished = F.take(finished.reshape(-1), flat_parent,
+                                      axis=0).reshape(B, K)
+                    if incremental:
+                        # KV pages follow their beams: gather page
+                        # CONTENTS over the FULL pool (tables are the
+                        # static contiguous runs above; row 0 — the
+                        # trash page — maps to itself)
+                        idx_pages = F.concat(
+                            zero_page,
+                            (flat_parent.expand_dims(1) * P + page_off
+                             + 1).reshape(-1), dim=0)
+                        pools = [[F.take(kp, idx_pages, axis=0),
+                                  F.take(vp, idx_pages, axis=0)]
+                                 for kp, vp in pools]
+                tok_col = tok.reshape(BK, 1)
+                maskc = F.broadcast_equal(
+                    col_iota, nd_array(_np.array([[t]], _np.int32), ctx=ctx,
+                                       dtype="int32"))
+                tgt = tgt * (1 - maskc) + tok_col * maskc
+                fin_tok = F.broadcast_maximum(
+                    F.broadcast_equal(tok, eos_nd),
+                    F.broadcast_equal(tok, pad_nd))
+                finished = F.broadcast_maximum(finished,
+                                               F.cast(fin_tok, "float32"))
+                last_tok = tok_col
+                # early exit at sync cadence: ONE scalar readback per
+                # `sync_every` steps, never per token
+                if (sync_every and t % sync_every == 0
+                        and t < max_len - 1
+                        and float(finished.sum().asscalar()) >= BK):
+                    break
+            tgt_np = _np.asarray(tgt.asnumpy(), _np.int32)
+            scores_np = _np.asarray(scores.asnumpy(), _np.float32)
         # GNMT length penalty: score / ((5+len)/6)^alpha
-        lengths = (tgt.reshape(B, K, max_len) != self._pad_id).sum(-1)
+        lengths = (tgt_np.reshape(B, K, max_len) != self._pad_id).sum(-1)
         penal = ((5.0 + lengths) / 6.0) ** alpha
-        best = _np.argmax(scores / penal, axis=1)
-        out = tgt.reshape(B, K, max_len)[_np.arange(B), best]
+        best = _np.argmax(scores_np / penal, axis=1)
+        out = tgt_np.reshape(B, K, max_len)[_np.arange(B), best]
         return out
 
 

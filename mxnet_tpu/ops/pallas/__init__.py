@@ -17,6 +17,7 @@ on the 8-device virtual mesh) and compile through Mosaic on TPU.
 """
 from .flash_attention import flash_attention
 from .fused import layer_norm, softmax_cross_entropy
+from .paged_attention import paged_decode_attention
 
 import os
 
@@ -66,4 +67,5 @@ def compute_on(platform: str):
 
 
 __all__ = ["flash_attention", "softmax_cross_entropy", "layer_norm",
-           "enabled", "use_compiled", "compute_on"]
+           "paged_decode_attention", "enabled", "use_compiled",
+           "compute_on"]

@@ -50,8 +50,9 @@ from .. import memwatch
 from .. import telemetry
 from ..base import MXNetError
 
-__all__ = ["AsyncLoss", "StackedAsyncLoss", "SuperstepLossView",
-           "StepFence", "InflightRing", "inflight_limit", "drain_all"]
+__all__ = ["AsyncLoss", "AsyncResult", "StackedAsyncLoss",
+           "SuperstepLossView", "StepFence", "InflightRing",
+           "inflight_limit", "drain_all"]
 
 _DEFAULT_INFLIGHT = 2
 
@@ -194,6 +195,15 @@ class AsyncLoss(_PendingHandle):
     def __array__(self, dtype=None, *args, **kwargs):
         out = self.asnumpy()
         return out if dtype is None else out.astype(dtype)
+
+
+class AsyncResult(AsyncLoss):
+    """Generic lazy device->host handle over ANY array-valued dispatch —
+    the same forcing/ring/error semantics as :class:`AsyncLoss`, result
+    returned as the raw ``np.ndarray``.  The serving engine
+    (``mxnet_tpu.serving.engine``) admits one per compiled decode step
+    (the (S,) per-slot token vector) through its bounded ring, so token
+    readbacks happen at stream cadence, never per token."""
 
 
 class StackedAsyncLoss(AsyncLoss):

@@ -1,0 +1,24 @@
+"""Inference serving: continuous batching over a paged KV cache
+(docs/SERVING.md).
+
+The production answer to "one ``Transformer.translate()`` call per
+request": a fixed-slot engine whose hot loop is ONE compiled decode step
+shared by ragged in-flight requests (paged KV cache + page tables, per
+*Ragged Paged Attention*), a request queue with in-flight admission/
+eviction between decode steps, lazy token readback at stream cadence
+through the PR 4 ``InflightRing``, AOT-cached executables for
+millisecond restarts, and ``serve_request`` SLO telemetry on the PR 2
+recorder.
+"""
+from .paged_cache import (PagedKVCache, PagedStepCache, gather_pages,
+                          page_coords, paged_attend, pages_for, write_page)
+from .scheduler import (ContinuousBatchingScheduler, Request, TokenStream,
+                        queue_bound)
+from .engine import (FullPrefixAdapter, ServingAdapter, ServingEngine,
+                     TransformerAdapter)
+
+__all__ = ["PagedKVCache", "PagedStepCache", "gather_pages", "page_coords",
+           "paged_attend", "pages_for", "write_page",
+           "ContinuousBatchingScheduler", "Request", "TokenStream",
+           "queue_bound", "ServingAdapter", "ServingEngine",
+           "TransformerAdapter", "FullPrefixAdapter"]

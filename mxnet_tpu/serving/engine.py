@@ -1,0 +1,786 @@
+"""Continuous-batching inference engine: ONE compiled decode step shared
+by ragged in-flight requests (docs/SERVING.md).
+
+The hot loop is a single jitted ``decode_step`` over ``S`` fixed decode
+*slots*: every input is shape-stable — per-slot positions, page tables
+and validity masks are device VALUES, never shapes — so mixed-length
+requests arriving mid-flight reuse one executable with zero per-length
+retraces (asserted via memwatch compile events in tests/test_serving.py).
+Prefill (encode for seq2seq, prompt ingestion for decoder-only) runs as a
+second compiled executable over a fixed padded shape, or folds into the
+decode step entirely (``FullPrefixAdapter``).
+
+Dispatch is a lazy pipeline reusing the PR 4 ``InflightRing`` semantics:
+``_dispatch_step`` chains device state -> device state and admits one
+:class:`~mxnet_tpu.parallel.async_loss.AsyncResult` token handle per step
+without ever blocking; the host reads tokens back in bursts of
+``MX_SERVE_STREAM_EVERY`` steps (stream cadence — never per token), does
+scheduler bookkeeping (EOS -> free the slot's KV pages immediately, admit
+waiting requests mid-flight), and dispatches the next burst.
+
+Any model servable here implements :class:`ServingAdapter` — the
+"cached-decode interface".  Seeds: :class:`TransformerAdapter`
+(models/transformer.py, paged KV decode refactored from its dense cache)
+and :class:`FullPrefixAdapter` (any fixed-shape logits function — e.g.
+an ONNX-imported decoder-only SymbolBlock — served O(L^2) but still
+one-executable).
+
+Both executables AOT-cache through mxnet_tpu.aot_cache (fingerprint
+variants ``("decode", page_size, slots)`` / ``("prefill", src_max)``):
+with ``MX_EXECUTABLE_CACHE_DIR`` set a serving-process restart
+deserializes in milliseconds instead of recompiling.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import aot_cache
+from .. import memwatch
+from .. import telemetry
+from ..base import MXNetError, env_int
+from ..parallel.async_loss import AsyncResult, InflightRing
+from .paged_cache import PagedKVCache, PagedStepCache, page_coords, pages_for
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ["ServingAdapter", "TransformerAdapter", "FullPrefixAdapter",
+           "ServingEngine"]
+
+
+def _serve_fused() -> bool:
+    """MX_SERVE_FLASH: 'auto' (default) fuses paged attention through the
+    Pallas kernel only where it compiles natively (TPU); 1 forces it
+    (interpret-mode tests); 0 pins the XLA gather path (the bitwise-
+    parity path)."""
+    raw = os.environ.get("MX_SERVE_FLASH", "auto").lower()
+    if raw in ("0", "false", "off"):
+        return False
+    if raw in ("1", "true", "on"):
+        return True
+    from ..ops import pallas
+
+    return pallas.enabled() and pallas.use_compiled()
+
+
+# ---------------------------------------------------------------------------
+# the cached-decode interface
+# ---------------------------------------------------------------------------
+class ServingAdapter:
+    """What a model must expose to be served.
+
+    Attributes: ``num_layers``/``num_heads``/``head_dim`` size the paged
+    KV pools (ignored when ``uses_pages`` is False).  All ``F``-taking
+    methods run BOTH eagerly and inside the engine's jit trace — NDArray
+    ops only, shapes static, values free."""
+
+    uses_pages = True
+    num_layers = 0
+    num_heads = 1
+    head_dim = 1
+
+    def extra_state(self, slots: int, ctx, dtype: str):
+        """Adapter-owned device state with a leading slot dim (e.g. the
+        encoder memory per slot).  OrderedDict name -> NDArray."""
+        return OrderedDict()
+
+    #: extra-state keys the prefill executable produces, in output
+    #: order (static — an AOT-cache-hit prefill never traces, so the
+    #: names cannot be discovered from the trace)
+    prefill_names = ()
+
+    def prefill_src(self, request: Request):
+        """Padded (1, Ts) int32 numpy prefill input for the separate
+        prefill executable, or None when prefill folds into decode."""
+        return None
+
+    def prefill(self, F, src):
+        """Traced prefill: (1, Ts) tokens -> dict of extra-state rows
+        (each (1, ...)) to install into the request's slot."""
+        return {}
+
+    def install(self, state, slot: int, request: Request) -> None:
+        """Eager per-slot state init at admission (after core defaults
+        tok=bos, pos=0 and any prefill rows are in place)."""
+
+    def validate(self, request: Request) -> None:
+        """Reject a request THIS adapter cannot serve, at submit time
+        (raise MXNetError).  Anything that would silently truncate or
+        corrupt later must fail loudly here."""
+
+    def max_positions(self):
+        """The largest decode position the model can represent (e.g. its
+        positional-embedding table length), or None for unbounded.  The
+        engine refuses a ``max_len`` beyond it at construction — the
+        gather-based position lookup would silently CLAMP out-of-table
+        positions instead of failing."""
+        return None
+
+    def signature(self):
+        """Extra structural identity for the AOT-cache fingerprint:
+        anything that changes the traced decode program without changing
+        shapes (e.g. the fused-attention decision) MUST appear here, or
+        a restart could deserialize the wrong executable."""
+        return ()
+
+    def warmup(self, ctx) -> None:
+        """One tiny eager forward so deferred-init parameters take their
+        shapes before the engine traces (gluon Dense layers infer shapes
+        on first call)."""
+
+    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
+               extra, pools):
+        """Traced decode of ONE position for every slot.  Returns
+        (next_tok (S,) int32, new_extra dict, new_pools list)."""
+        raise NotImplementedError
+
+
+class TransformerAdapter(ServingAdapter):
+    """models/transformer.py seq2seq decode on the paged KV cache.
+
+    Prefill = the encoder over the source padded to ``src_max_len``
+    (one compiled prefill regardless of source length); decode = the
+    same ``Transformer._decode_step`` the standalone ``translate`` runs,
+    greedy (log-softmax argmax — matches ``translate(beam_size=1)``
+    token-for-token)."""
+
+    prefill_names = ("mem", "src_keep")
+
+    def __init__(self, model, src_max_len: int, fused: Optional[bool] = None):
+        self.model = model
+        self.src_max = int(src_max_len)
+        sa = model.decoder.layers[0].self_attn
+        self.num_layers = len(model.decoder.layers)
+        self.num_heads = sa._num_heads
+        self.head_dim = sa._head_dim
+        self._fused = fused
+
+    def _resolved_fused(self) -> bool:
+        """The fused decision, resolved ONCE and pinned — the traced
+        program and the AOT-cache fingerprint must agree on it."""
+        if self._fused is None:
+            self._fused = _serve_fused()
+        return self._fused
+
+    def max_positions(self):
+        return self.model.pos._max_length
+
+    def signature(self):
+        return ("fused", self._resolved_fused())
+
+    def extra_state(self, slots, ctx, dtype):
+        from ..ndarray import zeros as nd_zeros
+
+        units = self.model._units
+        return OrderedDict(
+            mem=nd_zeros((slots, self.src_max, units), ctx=ctx,
+                         dtype=dtype),
+            src_keep=nd_zeros((slots, self.src_max), ctx=ctx, dtype=dtype))
+
+    def validate(self, request):
+        if request.tokens.shape[0] > self.src_max:
+            raise MXNetError(
+                f"request {request.id} source length "
+                f"{request.tokens.shape[0]} > adapter src_max_len "
+                f"{self.src_max}")
+
+    def prefill_src(self, request):
+        toks = request.tokens
+        self.validate(request)
+        row = np.full((1, self.src_max), self.model._pad_id, np.int32)
+        row[0, :toks.shape[0]] = toks
+        return row
+
+    def prefill(self, F, src):
+        mem, src_keep = self.model._encode_h(F, src)
+        return {"mem": mem, "src_keep": src_keep}
+
+    def warmup(self, ctx):
+        from ..ndarray import array as nd_array
+
+        src = np.full((1, self.src_max), self.model._pad_id, np.int32)
+        src[0, 0] = 1
+        tgt = np.ones((1, 1), np.int32)
+        self.model(nd_array(src, ctx=ctx, dtype="int32"),
+                   nd_array(tgt, ctx=ctx, dtype="int32"))
+
+    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
+               extra, pools):
+        fused = self._resolved_fused()
+        caches = [PagedStepCache(pools[2 * i], pools[2 * i + 1], table,
+                                 pages, rows, keep,
+                                 lengths=lengths, fused=fused)
+                  for i in range(self.num_layers)]
+        logits = self.model._decode_step(F, tok, pos, extra["mem"],
+                                         extra["src_keep"], caches)
+        # argmax over log-softmax, the exact selection translate's beam
+        # update applies with beam_size=1 (token-for-token parity)
+        nxt = F.cast(F.argmax(logits.log_softmax(axis=-1), axis=-1),
+                     "int32")
+        new_pools = []
+        for c in caches:
+            new_pools.extend((c.k_pool, c.v_pool))
+        return nxt, extra, new_pools
+
+
+class FullPrefixAdapter(ServingAdapter):
+    """Serve ANY fixed-shape decoder-only logits function — prefill
+    chunked into the decode step (the prompt sits in the slot's token
+    buffer; the first decode computes it along with everything else).
+
+    ``logits_fn(F, buf) -> (S, L, V)`` over the (S, L) int32 token
+    buffer; e.g. a causal HybridBlock forward or an ONNX-imported
+    decoder.  O(L^2) per generated token (the universal fallback — no KV
+    cache assumptions), but still shape-stable: ONE executable for every
+    request length."""
+
+    uses_pages = False
+
+    def __init__(self, logits_fn, max_len: int, pad_id: int = 0):
+        self._fn = logits_fn
+        self.max_len = int(max_len)
+        self.pad_id = int(pad_id)
+
+    def extra_state(self, slots, ctx, dtype):
+        from ..ndarray import zeros as nd_zeros
+
+        return OrderedDict(
+            buf=nd_zeros((slots, self.max_len), ctx=ctx, dtype="int32"))
+
+    def validate(self, request):
+        need = request.tokens.shape[0] + request.max_new_tokens
+        if need > self.max_len:
+            raise MXNetError(
+                f"request {request.id} needs {need} buffer positions "
+                f"(prompt {request.tokens.shape[0]} + max_new "
+                f"{request.max_new_tokens}) > adapter max_len "
+                f"{self.max_len} — the fixed prefix buffer would "
+                "silently truncate")
+
+    def install(self, state, slot, request):
+        row = np.full((self.max_len,), self.pad_id, np.int32)
+        n = request.tokens.shape[0]
+        row[:n] = request.tokens
+        state["buf"][slot] = row
+        state["pos"][slot] = max(0, n - 1)
+
+    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
+               extra, pools):
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        buf = extra["buf"]
+        logits = self._fn(F, buf)                      # (S, L, V)
+        S, L, V = logits.shape
+        step = jnp.take_along_axis(
+            logits._data, pos._data[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]                              # (S, V)
+        lp = NDArray(step, ctx=buf.context).log_softmax(axis=-1)
+        nxt = F.cast(F.argmax(lp, axis=-1), "int32")
+        wpos = jnp.minimum(pos._data + 1, L - 1)
+        new_buf = NDArray(
+            buf._data.at[jnp.arange(S), wpos].set(nxt._data),
+            ctx=buf.context)
+        return nxt, {"buf": new_buf}, []
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+class _Active:
+    """Host bookkeeping of one occupied slot."""
+
+    __slots__ = ("req", "pos", "done", "seq")
+
+    def __init__(self, req: Request, seq: int):
+        self.req = req
+        self.pos = 0      # mirrors the slot's DEVICE position counter
+        self.done = False
+        self.seq = seq    # admission order (preemption evicts youngest)
+
+
+class ServingEngine:
+    """Fixed-slot continuous-batching engine over one compiled decode
+    step (module docstring has the architecture; docs/SERVING.md the
+    knobs)."""
+
+    def __init__(self, adapter: ServingAdapter, slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None, max_len: int = 64,
+                 stream_every: Optional[int] = None,
+                 queue_bound: Optional[int] = None, ctx=None,
+                 dtype: str = "float32"):
+        from ..context import current_context
+        from ..ndarray import zeros as nd_zeros
+
+        self._adapter = adapter
+        self._ctx = ctx if ctx is not None else current_context()
+        self._S = slots if slots is not None else env_int("MX_SERVE_SLOTS", 8)
+        self._ps = page_size if page_size is not None \
+            else env_int("MX_SERVE_PAGE_SIZE", 16)
+        self._max_len = int(max_len)
+        self._stream_every = max(1, stream_every if stream_every is not None
+                                 else env_int("MX_SERVE_STREAM_EVERY", 4))
+        self._dtype = dtype
+        cap = adapter.max_positions()
+        if cap is not None and self._max_len > cap:
+            raise MXNetError(
+                f"engine max_len {self._max_len} > the model's "
+                f"max_positions {cap} (positional table) — out-of-table "
+                "positions would silently clamp; lower max_len or build "
+                "the model with a larger max_length")
+        if adapter.uses_pages:
+            n_pages = pool_pages if pool_pages is not None \
+                else env_int("MX_SERVE_POOL_PAGES", 0)
+            if not n_pages:  # auto: every slot can reach max_len
+                n_pages = self._S * pages_for(self._max_len, self._ps) + 1
+            self._cache = PagedKVCache(
+                adapter.num_layers, n_pages, self._ps, adapter.num_heads,
+                adapter.head_dim, ctx=self._ctx, dtype=dtype)
+            # table wide enough that positions overrun by a full burst
+            # (a request finishing mid-burst keeps decoding until the
+            # stream boundary) land on zero -> trash page, never clamp
+            # into a live page
+            self._P = pages_for(self._max_len + self._stream_every,
+                                self._ps)
+        else:
+            self._cache = None
+            self._P = 1
+        self._sched = ContinuousBatchingScheduler(queue_bound)
+        self._ring = InflightRing("ServingEngine")
+        self._slots: List[Optional[_Active]] = [None] * self._S
+        self._arrivals: List = []  # (arrive_at_step, request), sorted
+        self._step_n = 0
+        self._admit_seq = 0
+
+        # device state: core (tok/pos/table) + adapter extra + pools;
+        # everything the compiled step threads state -> state
+        state = OrderedDict(
+            tok=nd_zeros((self._S, 1), ctx=self._ctx, dtype="int32"),
+            pos=nd_zeros((self._S,), ctx=self._ctx, dtype="int32"),
+            table=nd_zeros((self._S, self._P), ctx=self._ctx,
+                           dtype="int32"))
+        extra = adapter.extra_state(self._S, self._ctx, dtype)
+        self._extra_names = list(extra)
+        state.update(extra)
+        self._pool_names: List[str] = []
+        if self._cache is not None:
+            for i, (kp, vp) in enumerate(self._cache.pools):
+                state[f"kpool{i}"] = kp
+                state[f"vpool{i}"] = vp
+                self._pool_names += [f"kpool{i}", f"vpool{i}"]
+        self._state = state
+        self._names = list(state)
+
+        self._param_items = None
+        self._run = None
+        self._prefill_run = None
+        self._prefill_names: List[str] = []
+        self._pending_compile: Dict = {}
+        # live-array census category for the watchdog: the paged pools +
+        # slot state are the serving engine's resident footprint
+        memwatch.register("serving", self,
+                          lambda eng: [a._data for a in
+                                       eng._state.values()])
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        if request.max_new_tokens > self._max_len:
+            raise MXNetError(
+                f"request {request.id} max_new_tokens "
+                f"{request.max_new_tokens} > engine max_len "
+                f"{self._max_len}")
+        self._adapter.validate(request)
+        return self._sched.submit(request)
+
+    def serve(self, requests, arrival_steps=None) -> Dict[str, np.ndarray]:
+        """Decode ``requests`` to completion; returns {id: tokens}.
+
+        ``arrival_steps`` (optional, aligned with ``requests``) delays
+        request i until the engine's global decode-step counter reaches
+        that value — mid-flight joins, the continuous-batching test
+        surface.  Requests with arrival 0/None submit immediately."""
+        requests = list(requests)
+        if arrival_steps is None:
+            arrival_steps = [0] * len(requests)
+        base = self._step_n
+        for req, at in zip(requests, arrival_steps):
+            if at:
+                self._arrivals.append((base + int(at), req))
+            else:
+                self.submit(req)
+        self._arrivals.sort(key=lambda p: p[0])
+        self.run()
+        return {r.id: r.stream.asarray() for r in requests}
+
+    def run(self, max_steps: int = 1_000_000) -> None:
+        """Drive the engine until queue, arrivals and slots are empty."""
+        self._ensure_compiled()
+        guard = 0
+        while True:
+            self._pump_arrivals()
+            admitted = self._admit_ready()
+            active = sum(1 for m in self._slots if m is not None)
+            if not active:
+                if self._arrivals:
+                    # idle: fast-forward the step clock to the next join
+                    self._step_n = max(self._step_n, self._arrivals[0][0])
+                    continue
+                if self._sched.depth:
+                    if not admitted:  # every slot free yet none admitted
+                        raise MXNetError(
+                            "serving queue non-empty but no request "
+                            "admissible (pool/config too small?)")
+                    continue
+                break
+            burst = self._ensure_pages(self._stream_every)
+            handles = [self._dispatch_step() for _ in range(burst)]
+            self._book_pending_compile()
+            self._consume(handles)
+            telemetry.record_serve_state(queue_depth=self._sched.depth,
+                                         active_slots=active)
+            guard += burst
+            if guard > max_steps:
+                raise MXNetError(f"serving run exceeded {max_steps} decode "
+                                 "steps (runaway request set?)")
+        self._ring.drain()
+
+    @property
+    def step_count(self) -> int:
+        return self._step_n
+
+    # ------------------------------------------------------------------
+    # compiled step construction
+    # ------------------------------------------------------------------
+    def _params(self):
+        if self._param_items is None:
+            model = getattr(self._adapter, "model", None)
+            self._param_items = (list(model.collect_params().items())
+                                 if model is not None else [])
+        return tuple(p.data(self._ctx)._data for _, p in self._param_items)
+
+    def _traced(self, body):
+        """Run ``body`` under the parameter-substitution trace (the
+        CachedOp recipe): model code sees traced param values, dropout/BN
+        stay in inference mode."""
+        from .. import autograd
+        from ..gluon.parameter import begin_trace, end_trace
+
+        def fn(param_arrays, *arrays):
+            from ..ndarray import NDArray
+
+            param_map = {p: NDArray(a, ctx=self._ctx)
+                         for (_, p), a in zip(self._param_items,
+                                              param_arrays)}
+            nds = [NDArray(a, ctx=self._ctx) for a in arrays]
+            prev = begin_trace(param_map, self._ctx)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(False)
+            try:
+                out = body(nds)
+            finally:
+                end_trace(prev)
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+            return tuple(o._data for o in out)
+
+        return fn
+
+    def _decode_body(self, nds):
+        from .. import ndarray as F
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        state = dict(zip(self._names, nds))
+        tok, pos, table = state["tok"], state["pos"], state["table"]
+        lengths = pos + 1  # rows valid incl. the one written this step
+        Lmax = self._P * self._ps
+        keep = NDArray(
+            (jnp.arange(Lmax, dtype=jnp.float32)[None, :]
+             < lengths._data.astype(jnp.float32)[:, None])
+            .astype(jnp.float32), ctx=self._ctx)
+        pages, rows = page_coords(table, pos, self._ps)
+        extra = {k: state[k] for k in self._extra_names}
+        pools = [state[k] for k in self._pool_names]
+        nxt, new_extra, new_pools = self._adapter.decode(
+            F, tok, pos, table, keep, pages, rows, lengths, extra, pools)
+        new_state = dict(state)
+        new_state["tok"] = nxt.reshape(self._S, 1)
+        new_state["pos"] = pos + 1
+        new_state.update(new_extra)
+        new_state.update(dict(zip(self._pool_names, new_pools)))
+        return (nxt,) + tuple(new_state[k] for k in self._names)
+
+    def _shape_sig(self, arrays):
+        return tuple((tuple(np.shape(a)), str(getattr(a, "dtype", "?")))
+                     for a in arrays)
+
+    def _fingerprint_parts(self, variant, arg_arrays):
+        """Restart-stable structural identity (the memwatch.fingerprint /
+        aot_cache key contract — shapes/dtypes/config, no object ids)."""
+        model = getattr(self._adapter, "model", None)
+        return (("ServingEngine",) + tuple(variant)
+                + (type(self._adapter).__name__,
+                   type(model).__name__ if model is not None else "",
+                   tuple(self._adapter.signature()),
+                   self._S, self._ps, self._P, self._max_len,
+                   self._shape_sig(arg_arrays)))
+
+    def _resolve(self, jfn, args, variant, site):
+        """AOT-resolve one executable through the persistent cache;
+        falls back to plain jit dispatch (compile booked at first call
+        via ``_pending_compile``)."""
+        # fingerprint over params + operands
+        flat = list(args[0]) + list(args[1:])
+        parts = self._fingerprint_parts(variant, flat)
+        dev = self._ctx.jax_device
+        t0 = time.perf_counter()
+        compiled, info = aot_cache.get_or_compile(
+            jfn, args, fingerprint=memwatch.fingerprint(parts),
+            platform=dev.platform, mesh_shape=(),
+            device_ids=(int(dev.id),))
+        if compiled is not None:
+            memwatch.note_compile(
+                "ServingEngine", parts,
+                wall_s=time.perf_counter() - t0, site=site,
+                jitted=None if info.get("cache_hit") else jfn,
+                args=memwatch.shape_structs(args), **info)
+            return compiled
+        self._pending_compile[site] = {"parts": parts, "jitted": jfn,
+                                       "args": memwatch.shape_structs(args)}
+        return jfn
+
+    def _ensure_compiled(self):
+        if self._run is not None:
+            return
+        import jax
+
+        self._adapter.warmup(self._ctx)  # deferred-init shapes first
+        self._params()  # resolve the param list before tracing
+        jfn = jax.jit(self._traced(self._decode_body))
+        args = (self._params(),) + tuple(a._data
+                                         for a in self._state.values())
+        self._run = self._resolve(jfn, args,
+                                  ("decode", self._ps, self._S),
+                                  "serving_decode")
+
+    def _ensure_prefill(self, src_row):
+        if self._prefill_run is not None:
+            return
+        import jax
+
+        adapter = self._adapter
+        self._prefill_names = list(adapter.prefill_names)
+
+        def body(nds):
+            from .. import ndarray as F
+
+            out = adapter.prefill(F, nds[0])
+            return [out[k] for k in adapter.prefill_names]
+
+        jfn = jax.jit(self._traced(body))
+        import jax.numpy as jnp
+
+        args = (self._params(), jnp.asarray(src_row))
+        self._prefill_run = self._resolve(
+            jfn, args, ("prefill", src_row.shape[1]), "serving_prefill")
+
+    def _book_pending_compile(self):
+        """Book plain-jit compiles AFTER the dispatching burst (the hot
+        body never pays the analysis retrace).  Only entries whose first
+        call already happened (wall_s stamped) are booked."""
+        done = [s for s, r in self._pending_compile.items()
+                if "wall_s" in r]
+        for site in done:
+            rec = self._pending_compile.pop(site)
+            memwatch.note_compile(
+                "ServingEngine", rec["parts"], wall_s=rec["wall_s"],
+                site=site, jitted=rec["jitted"], args=rec["args"])
+
+    # ------------------------------------------------------------------
+    # the hot dispatch body (mxlint HOT_PATH_ENTRIES: no host syncs)
+    # ------------------------------------------------------------------
+    def _dispatch_step(self):
+        """Dispatch ONE compiled decode step: device state chains to
+        device state, the per-step token vector rides out as a lazy
+        AsyncResult through the bounded ring.  Never blocks on device
+        results (make_room bounds the window oldest-first)."""
+        self._ring.make_room(self._stream_every, wait_span=False)
+        arrays = [a._data for a in self._state.values()]
+        t0 = time.perf_counter()
+        outs = self._run(self._params(), *arrays)
+        if "serving_decode" in self._pending_compile:
+            self._pending_compile["serving_decode"].setdefault(
+                "wall_s", time.perf_counter() - t0)
+        toks = outs[0]
+        from ..ndarray import NDArray
+
+        for name, arr in zip(self._names, outs[1:]):
+            self._state[name] = NDArray(arr, ctx=self._ctx)
+        self._step_n += 1
+        handle = AsyncResult(toks, step=self._step_n,
+                             executor="ServingEngine", ring=self._ring)
+        self._ring.admit(handle)
+        return handle
+
+    # ------------------------------------------------------------------
+    # host-side scheduling (stream boundaries only)
+    # ------------------------------------------------------------------
+    def _pump_arrivals(self):
+        while self._arrivals and self._arrivals[0][0] <= self._step_n:
+            _, req = self._arrivals.pop(0)
+            self.submit(req)
+
+    def _admit_ready(self) -> int:
+        free = [i for i, m in enumerate(self._slots) if m is None]
+        if not free or not self._sched.depth:
+            return 0
+        pages_free = (self._cache.pages_free if self._cache is not None
+                      else len(free))
+        ready = self._sched.pop_ready(len(free), pages_free, self._ps)
+        for slot, req in zip(free, ready):
+            self._admit(slot, req)
+        return len(ready)
+
+    def _admit(self, slot: int, req: Request):
+        st = self._state
+        src = self._adapter.prefill_src(req)
+        if src is not None:
+            self._ensure_prefill(src)
+            import jax.numpy as jnp
+
+            t0 = time.perf_counter()
+            outs = self._prefill_run(self._params(), jnp.asarray(src))
+            # prefill_ms is DISPATCH wall (async queueing, like step
+            # events — see telemetry.record_step's contract)
+            req.prefill_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            if "serving_prefill" in self._pending_compile:
+                self._pending_compile["serving_prefill"].setdefault(
+                    "wall_s", time.perf_counter() - t0)
+                self._book_pending_compile()
+            from ..ndarray import NDArray
+
+            for name, arr in zip(self._prefill_names, outs):
+                st[name][slot] = NDArray(arr, ctx=self._ctx)[0]
+        st["tok"][slot, 0] = req.bos_id
+        st["pos"][slot] = 0
+        self._adapter.install(st, slot, req)
+        self._admit_seq += 1
+        self._slots[slot] = _Active(req, self._admit_seq)
+
+    def _ensure_pages(self, burst: int) -> int:
+        """Grow page tables so every active, unfinished slot can decode
+        ``burst`` more positions; shrinks the burst when the pool runs
+        dry.  Under real pool pressure (some slot cannot advance even
+        one step) the YOUNGEST-admitted request is preempted back to the
+        queue head (vLLM-style recompute preemption — greedy decode is
+        deterministic, so re-decoding reproduces its tokens) until the
+        survivors can advance; a single request that cannot fit at all
+        is a configuration error and raises."""
+        if self._cache is None:
+            return burst
+        while True:
+            feas = self._grow_tables(burst)
+            if feas > 0:
+                return feas
+            cands = [(m.seq, slot, m) for slot, m in enumerate(self._slots)
+                     if m is not None and not m.done]
+            if len(cands) <= 1:
+                raise MXNetError(
+                    "paged KV pool cannot hold even one in-flight "
+                    "request — raise MX_SERVE_POOL_PAGES (or lower "
+                    f"max_len); pool {self._cache.num_pages} pages of "
+                    f"{self._ps} tokens")
+            _, slot, meta = max(cands)
+            self._preempt(slot, meta)
+
+    def _grow_tables(self, burst: int) -> int:
+        """One growth pass; returns the feasible burst (0 = some slot is
+        starved)."""
+        feas = burst
+        st = self._state
+        for slot, meta in enumerate(self._slots):
+            if meta is None or meta.done:
+                continue
+            rem = meta.req.max_new_tokens - len(meta.req.stream)
+            want = min(burst, rem)
+            need_pages = pages_for(meta.pos + want, self._ps)
+            have = len(self._cache.owned(slot))
+            if need_pages > have:
+                if self._cache.alloc(slot, need_pages - have) is None:
+                    # pool can't cover the whole growth: grab what's left
+                    while (self._cache.pages_free
+                           and len(self._cache.owned(slot)) < need_pages):
+                        self._cache.alloc(slot, 1)
+                st["table"][slot] = self._cache.table_row(slot, self._P)
+            cap = self._cache.capacity_rows(slot)
+            if cap - meta.pos < want:
+                feas = min(feas, cap - meta.pos)
+        return max(0, feas)
+
+    def _preempt(self, slot: int, meta: _Active):
+        """Evict a request mid-decode under pool pressure: pages free
+        NOW, the request returns to the queue HEAD and recomputes from
+        scratch on re-admission (its stream resets — deterministic
+        greedy decode re-emits identical tokens)."""
+        st = self._state
+        self._cache.free_slot(slot)
+        st["table"][slot] = 0
+        st["pos"][slot] = 0
+        for name in self._extra_names:
+            st[name][slot] = 0
+        req = meta.req
+        req.stream.tokens.clear()
+        req.t_admit = None
+        req.prefill_ms = 0.0
+        telemetry.record("serve_preempt", request_id=req.id,
+                         decoded=meta.pos)
+        self._sched.requeue(req)
+        self._slots[slot] = None
+
+    def _consume(self, handles):
+        """Stream boundary: force the burst's token handles (the ONLY
+        host readback), append to per-request streams, finish + evict
+        completed requests so their pages free immediately."""
+        for h in handles:
+            toks = h.asnumpy()
+            for slot, meta in enumerate(self._slots):
+                if meta is None:
+                    continue
+                meta.pos += 1  # device pos advanced for every slot
+                if meta.done:
+                    continue
+                req = meta.req
+                tok = int(toks[slot])
+                req.stream.append(tok)
+                if tok == req.eos_id:
+                    meta.done = True
+                    req.stream.finish("eos")
+                elif len(req.stream) >= req.max_new_tokens:
+                    meta.done = True
+                    req.stream.finish("length")
+        for slot, meta in enumerate(self._slots):
+            if meta is not None and meta.done:
+                self._evict(slot, meta)
+
+    def _evict(self, slot: int, meta: _Active):
+        st = self._state
+        if self._cache is not None:
+            self._cache.free_slot(slot)
+        st["table"][slot] = 0
+        st["pos"][slot] = 0
+        for name in self._extra_names:
+            st[name][slot] = 0
+        req = meta.req
+        decode_ms = max(0.0, (time.perf_counter() - req.t_admit) * 1e3
+                        - req.prefill_ms) if req.t_admit else 0.0
+        telemetry.record_serve_request(
+            queue_wait_ms=req.queue_wait_ms, prefill_ms=req.prefill_ms,
+            decode_ms=round(decode_ms, 3), tokens=len(req.stream),
+            request_id=req.id, reason=req.stream.finish_reason)
+        self._slots[slot] = None

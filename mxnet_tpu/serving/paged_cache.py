@@ -1,0 +1,254 @@
+"""Paged KV cache: ragged decode lengths sharing one preallocated pool
+(docs/SERVING.md §Paged KV cache).
+
+The design of *Ragged Paged Attention* (PAPERS.md, arxiv 2604.15464):
+instead of one dense ``(B, Lmax, C)`` K/V buffer per layer — whose batch
+rows must all be the same padded length, and whose shape retraces the
+decode executable whenever the padded length changes — each layer keeps a
+fixed pool of ``(num_pages, page_size, heads, head_dim)`` blocks plus a
+per-slot **page table**.  A request of any length owns just the pages its
+tokens fill; attention gathers the slot's pages back into a dense view by
+table lookup, so the compiled decode step sees ONE static shape
+regardless of how long each in-flight request has grown.  Freed pages
+return to the pool the moment a request finishes (continuous batching's
+memory half).
+
+Two layers live here:
+
+  * functional math (``page_coords`` / ``write_page`` / ``gather_pages``
+    / ``paged_attend``) — pure NDArray-in/NDArray-out helpers that run
+    eagerly AND inside a jit trace (the serving engine's compiled decode
+    step, ``models.transformer.translate``'s device-side beam loop).
+    ``paged_attend`` reuses the exact ``_attend_cached`` op sequence on
+    the gathered dense view, so paged decode is **bitwise identical** to
+    the dense-cache decode for the same tokens (asserted by
+    tests/test_serving.py).
+  * ``PagedKVCache`` — the host-side allocator (free list + per-slot
+    page ownership) and pool factory.  Page 0 is reserved as the trash
+    page: empty slots' all-zero table rows route their (discarded)
+    writes there, so inactive decode lanes can never corrupt a live
+    request's cache.
+
+The fused alternative to the gather (``ops.pallas.paged_attention``)
+never materialises the dense view; see ``PagedStepCache(fused=True)``.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+from ..base import MXNetError
+
+__all__ = ["PagedKVCache", "PagedStepCache", "page_coords", "write_page",
+           "gather_pages", "paged_attend", "pages_for"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _nd(data, like):
+    from ..ndarray import NDArray
+
+    return NDArray(data, ctx=like.context)
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` cache rows."""
+    return max(0, math.ceil(n_tokens / page_size))
+
+
+# ---------------------------------------------------------------------------
+# functional math (eager + trace)
+# ---------------------------------------------------------------------------
+def page_coords(table, pos, page_size: int):
+    """Device coordinates of decode position ``pos`` for every slot.
+
+    table: (S, P) int32 page table; pos: (S,) int32 per-slot position
+    (or (1,) broadcasting a uniform position, the translate case).
+    Returns ``(pages, rows)`` int32 NDArrays — ``pool[pages[s], rows[s]]``
+    is where slot ``s`` writes this step's k/v.  Out-of-range positions
+    clamp into the table (jnp gather semantics); callers keep positions
+    in range via the allocator."""
+    jnp = _jnp()
+    t, p = table._data, pos._data
+    if p.shape[0] != t.shape[0]:
+        p = jnp.broadcast_to(p, (t.shape[0],))
+    col = (p // page_size).astype(jnp.int32)
+    pages = jnp.take_along_axis(t, col[:, None], axis=1)[:, 0]
+    rows = (p % page_size).astype(jnp.int32)
+    return _nd(pages, table), _nd(rows, table)
+
+
+def write_page(pool, pages, rows, vals):
+    """Scatter one token's k (or v) per slot into the pool.
+
+    pool: (N, page_size, H, hd); pages/rows: (S,) int32; vals: (S, H, hd).
+    Returns the updated pool (functional — jax arrays are immutable)."""
+    new = pool._data.at[pages._data, rows._data].set(vals._data)
+    return _nd(new, pool)
+
+
+def gather_pages(pool, table):
+    """Dense (S, P*page_size, H*hd) view of every slot's pages.
+
+    The gather-by-page-table that makes ragged slots look like one
+    fixed-shape dense cache to the attention math.  Rows beyond a slot's
+    real length hold stale/zero garbage — callers mask them via ``keep``
+    exactly as the dense cache masks its unwritten tail."""
+    jnp = _jnp()
+    S, P = table.shape
+    N, ps, H, hd = pool.shape
+    flat = jnp.take(pool._data, table._data.reshape(-1), axis=0)
+    return _nd(flat.reshape(S, P * ps, H * hd), pool)
+
+
+def paged_attend(F, q_t, k_pool, v_pool, table, keep, num_heads, head_dim):
+    """One-query attention over paged K/V: gather the slots' pages into
+    the dense layout, then run the EXACT ``_attend_cached`` op sequence
+    on it.  Same values through the same eager executables => bitwise
+    identical to the dense-cache decode (the parity contract)."""
+    from ..models.transformer import _attend_cached
+
+    K = gather_pages(k_pool, table)
+    V = gather_pages(v_pool, table)
+    return _attend_cached(F, q_t, K, V, keep, num_heads, head_dim)
+
+
+class PagedStepCache:
+    """One decode step's view of a single layer's paged K/V pools — the
+    cache object ``TransformerDecoderCell.step`` writes/attends through
+    (the paged twin of ``models.transformer.DenseStepCache``).
+
+    ``pages``/``rows`` (from :func:`page_coords`) are computed once per
+    step by the caller and shared across layers; ``keep`` is the
+    (S, P*page_size) validity mask (1.0 = attend).  After
+    ``update_and_attend`` the updated pools are on ``.k_pool``/
+    ``.v_pool`` for the caller to thread into the next step's state.
+
+    ``fused=True`` routes attention through the Pallas paged decode
+    kernel (ops/pallas/paged_attention) instead of gather+dense — the
+    on-chip path that never materialises the dense view; numerically
+    equivalent (online softmax) but not bitwise, so it is opt-in
+    (``lengths`` (S,) int32 is required: the kernel masks by length, not
+    by ``keep``)."""
+
+    def __init__(self, k_pool, v_pool, table, pages, rows, keep,
+                 lengths=None, fused: bool = False):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.table = table
+        self.pages = pages
+        self.rows = rows
+        self.keep = keep
+        self.lengths = lengths
+        self._fused = fused
+        if fused and lengths is None:
+            raise MXNetError("PagedStepCache(fused=True) needs per-slot "
+                             "lengths for the kernel's ragged masking")
+
+    def update_and_attend(self, F, attn, q_t, k_t, v_t):
+        H, hd = attn._num_heads, attn._head_dim
+        S = k_t.shape[0]
+        k_vals = k_t.reshape(S, H, hd)
+        v_vals = v_t.reshape(S, H, hd)
+        self.k_pool = write_page(self.k_pool, self.pages, self.rows, k_vals)
+        self.v_pool = write_page(self.v_pool, self.pages, self.rows, v_vals)
+        if self._fused:
+            from ..ops.pallas.paged_attention import paged_decode_attention
+
+            q = q_t.reshape(S, H, hd)
+            out = paged_decode_attention(
+                q._data, self.k_pool._data, self.v_pool._data,
+                self.table._data, self.lengths._data)
+            return _nd(out.reshape(S, 1, H * hd), q_t)
+        return paged_attend(F, q_t, self.k_pool, self.v_pool, self.table,
+                            self.keep, H, hd)
+
+
+# ---------------------------------------------------------------------------
+# pool + allocator
+# ---------------------------------------------------------------------------
+class PagedKVCache:
+    """Fixed pool of KV pages per decoder layer + the host-side page
+    allocator.
+
+    The pools are plain NDArrays handed to the caller (the serving
+    engine threads them through its compiled decode step as functional
+    state; ``translate`` updates them in its beam loop) — this object
+    owns only the *bookkeeping*: which pages are free, which slot owns
+    which pages.  Page 0 is reserved (the trash page inactive slots
+    write to), so ``num_pages`` must leave room for it."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_heads: int, head_dim: int, ctx=None,
+                 dtype: str = "float32"):
+        if num_pages < 2:
+            raise MXNetError("PagedKVCache needs >= 2 pages (page 0 is "
+                             "the reserved trash page)")
+        from ..context import current_context
+        from ..ndarray import zeros as nd_zeros
+
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.ctx = ctx if ctx is not None else current_context()
+        shape = (self.num_pages, self.page_size, self.num_heads,
+                 self.head_dim)
+        self.pools = [(nd_zeros(shape, ctx=self.ctx, dtype=dtype),
+                       nd_zeros(shape, ctx=self.ctx, dtype=dtype))
+                      for _ in range(self.num_layers)]
+        # LIFO free list: recently-freed (cache-warm) pages reused first
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._owned: dict = {}
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def owned(self, slot) -> List[int]:
+        return list(self._owned.get(slot, ()))
+
+    def alloc(self, slot, n_pages: int) -> Optional[List[int]]:
+        """Grant ``n_pages`` more pages to ``slot`` (all-or-nothing).
+        Returns the newly granted pages, or None when the pool cannot
+        cover the request — the caller shrinks its dispatch burst or
+        defers the admission (never partial: a half-grown table would
+        let a decode position land on the trash page)."""
+        n_pages = int(n_pages)
+        if n_pages <= 0:
+            return []
+        if n_pages > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n_pages)]
+        self._owned.setdefault(slot, []).extend(got)
+        return got
+
+    def free_slot(self, slot) -> int:
+        """Return every page ``slot`` owns to the pool (request finished
+        / evicted — the continuous-batching moment waiting requests are
+        waiting for).  Returns how many pages came back."""
+        pages = self._owned.pop(slot, [])
+        self._free.extend(pages)
+        return len(pages)
+
+    def capacity_rows(self, slot) -> int:
+        """How many cache rows the slot's granted pages can hold."""
+        return len(self._owned.get(slot, ())) * self.page_size
+
+    def table_row(self, slot, max_pages: int):
+        """The slot's page-table row, zero-padded to ``max_pages``
+        (numpy int32 — callers setitem it into the device table)."""
+        import numpy as np
+
+        pages = self._owned.get(slot, [])
+        if len(pages) > max_pages:
+            raise MXNetError(f"slot {slot} owns {len(pages)} pages > "
+                             f"table width {max_pages}")
+        row = np.zeros((max_pages,), np.int32)
+        row[:len(pages)] = pages
+        return row

@@ -1,0 +1,155 @@
+"""Continuous-batching request scheduler (docs/SERVING.md §Scheduler).
+
+Requests enter a bounded FIFO queue and are admitted into fixed decode
+*slots* BETWEEN decode steps — in-flight batching: a finished request
+frees its slot (and its KV pages) at the next stream boundary and a
+waiting request joins mid-flight, so the compiled decode step never idles
+on ragged completion times.  The queue bound (``MX_SERVE_QUEUE``) is the
+backpressure surface: a full queue rejects loudly instead of growing
+without bound under overload (callers retry / shed upstream).
+
+Policy is deliberately plain FCFS: requests admit in arrival order when
+(a) a slot is free and (b) the paged KV pool can grant at least one page.
+Fancier policies (shortest-prompt-first, priority lanes) slot in by
+overriding :meth:`ContinuousBatchingScheduler.pop_ready`.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Request", "TokenStream", "ContinuousBatchingScheduler",
+           "queue_bound"]
+
+_ids = itertools.count()
+
+
+def queue_bound() -> int:
+    """Request-queue bound, re-read from ``MX_SERVE_QUEUE`` per call
+    (default 256; 0 = unbounded — load tests only)."""
+    try:
+        return max(0, int(os.environ.get("MX_SERVE_QUEUE", 256)))
+    except (TypeError, ValueError):
+        return 256
+
+
+class TokenStream:
+    """Per-request output stream: tokens append as the engine reads them
+    back at stream cadence; ``finished`` flips when the request
+    completes (EOS / token budget / eviction)."""
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.finished = False
+        self.finish_reason: Optional[str] = None
+
+    def append(self, tok: int) -> None:
+        self.tokens.append(int(tok))
+
+    def finish(self, reason: str) -> None:
+        self.finished = True
+        self.finish_reason = reason
+
+    def asarray(self) -> np.ndarray:
+        return np.asarray(self.tokens, np.int32)
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def __iter__(self):
+        return iter(self.tokens)
+
+
+class Request:
+    """One decode request.
+
+    ``tokens`` is the prompt — the source sentence for seq2seq models
+    (prefill = encode), the prompt prefix for decoder-only models
+    (prefill = fill the cache/buffer).  Generation starts from
+    ``bos_id`` and stops at ``eos_id`` or after ``max_new_tokens``."""
+
+    def __init__(self, tokens, max_new_tokens: int, bos_id: int,
+                 eos_id: int, request_id: Optional[str] = None):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        self.bos_id = int(bos_id)
+        self.eos_id = int(eos_id)
+        self.id = request_id if request_id is not None \
+            else f"req{next(_ids)}"
+        self.stream = TokenStream()
+        # SLO telemetry stamps (perf_counter; wall deltas only)
+        self.t_submit: Optional[float] = None
+        self.t_admit: Optional[float] = None
+        self.prefill_ms: float = 0.0
+
+    @property
+    def queue_wait_ms(self) -> float:
+        if self.t_submit is None or self.t_admit is None:
+            return 0.0
+        return (self.t_admit - self.t_submit) * 1e3
+
+    def __repr__(self):
+        return (f"<Request {self.id} prompt={len(self.tokens)} "
+                f"max_new={self.max_new_tokens} "
+                f"out={len(self.stream)}"
+                f"{' done' if self.stream.finished else ''}>")
+
+
+class ContinuousBatchingScheduler:
+    """Bounded FIFO of waiting requests + the admission policy."""
+
+    def __init__(self, bound: Optional[int] = None):
+        self._bound = bound
+        self._q: deque = deque()
+
+    @property
+    def bound(self) -> int:
+        return queue_bound() if self._bound is None else self._bound
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue a request; raises MXNetError when the queue is full
+        (the documented backpressure contract — shed upstream)."""
+        bound = self.bound
+        if bound and len(self._q) >= bound:
+            raise MXNetError(
+                f"serving queue full ({len(self._q)}/{bound} waiting): "
+                "raise MX_SERVE_QUEUE or shed load upstream")
+        request.t_submit = time.perf_counter()
+        self._q.append(request)
+        return request
+
+    def requeue(self, request: Request) -> None:
+        """Return a preempted request to the HEAD of the queue (pool
+        pressure evicted it mid-decode; it must not lose its place or be
+        dropped by the bound — preemption is the engine's problem, not
+        the client's)."""
+        self._q.appendleft(request)
+
+    def pop_ready(self, free_slots: int, pages_free: int,
+                  page_size: int) -> List[Request]:
+        """FCFS admissions for this stream boundary: up to ``free_slots``
+        requests, stopping when the paged pool cannot grant a first page
+        to the next head-of-line request (no skip-ahead: later, smaller
+        requests must not starve the head)."""
+        out: List[Request] = []
+        budget = pages_free
+        while self._q and len(out) < free_slots and budget >= 1:
+            req = self._q.popleft()
+            req.t_admit = time.perf_counter()
+            out.append(req)
+            budget -= 1  # reserve the first page; later pages grow on
+            #              demand per dispatch burst (engine._ensure_pages)
+        return out

@@ -71,6 +71,7 @@ import atexit
 import itertools
 import json
 import logging
+import math
 import os
 import threading
 import time
@@ -79,6 +80,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["enabled", "enable", "disable", "record", "record_step",
            "record_collective", "record_fused_update", "record_block_wait",
+           "record_serve_request", "record_serve_state",
            "heartbeat", "note_signature", "summary", "flight_tail", "flush",
            "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE",
            "span", "record_span", "spans_enabled", "export_chrome_trace",
@@ -153,6 +155,14 @@ class _State:
                      "compile_ms": 0.0}
         self.fused = {"count": 0, "n_params": 0, "n_buckets": 0,
                       "bytes": 0, "jitted_calls": 0}
+        # serving rollups (docs/SERVING.md §SLO telemetry): per-request
+        # aggregates + a bounded reservoir of end-to-end latencies for
+        # the rolling p50/p99, + the queue/slot gauges the engine stamps
+        # at every stream boundary
+        self.serve = {"requests": 0, "tokens": 0, "queue_wait_ms": 0.0,
+                      "prefill_ms": 0.0, "decode_ms": 0.0,
+                      "lat_ms": deque(maxlen=512),
+                      "queue_depth": 0, "active_slots": 0}
         self.ckpt = {"saves": 0, "save_ms": 0.0, "save_bytes": 0,
                      "loads": 0, "load_ms": 0.0, "fallbacks": 0}
         # executor -> {"sigs": set, "traces": int, "warned_at": int,
@@ -564,6 +574,54 @@ def record_fused_update(n_params: int, n_buckets: int, nbytes: int,
            nbytes=int(nbytes), n_jitted_calls=int(n_jitted_calls), **fields)
 
 
+def record_serve_request(queue_wait_ms: float = 0.0,
+                         prefill_ms: float = 0.0, decode_ms: float = 0.0,
+                         tokens: int = 0, **fields) -> None:
+    """One COMPLETED serving request (mxnet_tpu.serving.engine): how
+    long it queued, the prefill dispatch wall, the decode wall, and how
+    many tokens it produced.  End-to-end latency (the SLO number) is the
+    sum; a bounded reservoir of the newest 512 latencies backs the
+    rolling p50/p99 in ``summary()['serving']`` and the ``mx_serve_*``
+    gauges in :func:`export_prometheus`.  Per-request events land in the
+    flight ring, so a gang post-mortem tail shows the last served
+    requests."""
+    if not _state.enabled:
+        return
+    latency = float(queue_wait_ms) + float(prefill_ms) + float(decode_ms)
+    with _state.lock:
+        sv = _state.serve
+        sv["requests"] += 1
+        sv["tokens"] += int(tokens)
+        sv["queue_wait_ms"] += float(queue_wait_ms)
+        sv["prefill_ms"] += float(prefill_ms)
+        sv["decode_ms"] += float(decode_ms)
+        sv["lat_ms"].append(latency)
+    record("serve_request", queue_wait_ms=round(queue_wait_ms, 3),
+           prefill_ms=round(prefill_ms, 3), decode_ms=round(decode_ms, 3),
+           latency_ms=round(latency, 3), tokens=int(tokens), **fields)
+
+
+def record_serve_state(queue_depth: int, active_slots: int) -> None:
+    """Queue-depth / active-slot gauges, stamped by the serving engine
+    at every stream boundary (aggregate-only: no per-boundary event —
+    one boundary per few decode steps would drown the flight ring)."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        _state.serve["queue_depth"] = int(queue_depth)
+        _state.serve["active_slots"] = int(active_slots)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ascending list (stdlib-only —
+    telemetry must not import numpy)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(math.ceil(q / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
 def record_checkpoint(event: str, step: int, wall_s: float = 0.0,
                       nbytes: int = 0, **fields) -> None:
     """Checkpoint lifecycle: event in {save, load, fallback}."""
@@ -714,6 +772,23 @@ def flight_tail(k: int = 20) -> List[dict]:
         return list(_state.ring)[-k:]
 
 
+def _serving_rollup() -> dict:
+    """summary()['serving'] block (caller holds _state.lock)."""
+    sv = _state.serve
+    lat = sorted(sv["lat_ms"])
+    return {
+        "requests": sv["requests"],
+        "tokens": sv["tokens"],
+        "queue_wait_ms": round(sv["queue_wait_ms"], 3),
+        "prefill_ms": round(sv["prefill_ms"], 3),
+        "decode_ms": round(sv["decode_ms"], 3),
+        "p50_latency_ms": round(_percentile(lat, 50), 3),
+        "p99_latency_ms": round(_percentile(lat, 99), 3),
+        "queue_depth": sv["queue_depth"],
+        "active_slots": sv["active_slots"],
+    }
+
+
 def summary() -> dict:
     """JSON-serializable rollup of everything recorded so far.  Works even
     when the recorder is disabled (retrace tracking is always on)."""
@@ -755,6 +830,7 @@ def summary() -> dict:
             "checkpoints": {k: (round(v, 3) if isinstance(v, float) else v)
                             for k, v in _state.ckpt.items()},
             "fused_update": dict(_state.fused),
+            "serving": _serving_rollup(),
             "spans": {
                 name: {"count": agg["count"],
                        "total_ms": round(agg["total_ms"], 3),
@@ -1042,6 +1118,17 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
     gauge("mx_checkpoint_save_ms_total", ck["save_ms"], kind="counter")
     gauge("mx_checkpoint_loads_total", ck["loads"], kind="counter")
     gauge("mx_checkpoint_fallbacks_total", ck["fallbacks"], kind="counter")
+    sv = s["serving"]
+    if sv["requests"] or sv["queue_depth"] or sv["active_slots"]:
+        gauge("mx_serve_requests_total", sv["requests"], kind="counter")
+        gauge("mx_serve_tokens_total", sv["tokens"], kind="counter")
+        gauge("mx_serve_queue_wait_ms_total", sv["queue_wait_ms"],
+              kind="counter")
+        gauge("mx_serve_decode_ms_total", sv["decode_ms"], kind="counter")
+        gauge("mx_serve_latency_p50_ms", sv["p50_latency_ms"])
+        gauge("mx_serve_latency_p99_ms", sv["p99_latency_ms"])
+        gauge("mx_serve_queue_depth", sv["queue_depth"])
+        gauge("mx_serve_active_slots", sv["active_slots"])
     per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
     per_key("mx_span_ms_total", s["spans"], "total_ms", "span",
             kind="counter")

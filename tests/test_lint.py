@@ -748,6 +748,66 @@ def test_cli_exit_codes_and_json_schema(tmp_path):
     assert "unknown rule" in p.stderr
 
 
+def test_serving_dispatch_entry_registered_and_rename_fails_loudly(tmp_path):
+    """The serving engine's decode-dispatch body is in the REAL
+    HOT_PATH_ENTRIES (a host sync there would serialize the whole
+    serving pipeline), and renaming it in a fixture carrying the entry
+    flags stale-hot-entry rather than silently un-linting the path."""
+    real = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/serving/engine.py"]
+    assert "ServingEngine._dispatch_step" in real
+
+    entries = {"mxnet_tpu/fixture.py": ("ServingEngine._dispatch_step",)}
+    findings, _ = lint_src(tmp_path, """
+        class ServingEngine:
+            def _dispatch_step_renamed(self):
+                return None
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["stale-hot-entry"]
+    assert "ServingEngine._dispatch_step" in findings[0].message
+
+    # positive: a per-token host readback reachable from the dispatch
+    # body (the exact bug the serving refactor removed from translate)
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class ServingEngine:
+            def _dispatch_step(self):
+                outs = self._run()
+                return self._emit(outs)
+
+            def _emit(self, outs):
+                return np.asarray(outs[0])   # per-token sync: flagged
+
+            def _run(self):
+                return (object(),)
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+    assert findings[0].context == "ServingEngine._emit"
+
+    # negative: the real body's shape — chain device state, admit the
+    # lazy handle, stamp the compile wall — carries no syncs
+    findings, _ = lint_src(tmp_path, """
+        import time
+
+        class ServingEngine:
+            def _dispatch_step(self):
+                self._ring.make_room(self._window)
+                arrays = [a._data for a in self._state.values()]
+                t0 = time.perf_counter()
+                outs = self._run(self._params(), *arrays)
+                handle = self._wrap(outs[0])
+                self._ring.admit(handle)
+                return handle
+
+            def _params(self):
+                return tuple(p.data() for _, p in self._param_items)
+
+            def _wrap(self, toks):
+                return toks
+        """, hot_entries=entries)
+    assert findings == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
     (tmp_path / "mxnet_tpu").mkdir(parents=True)
     (tmp_path / "mxnet_tpu" / "broken.py").write_text("def f(:\n")

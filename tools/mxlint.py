@@ -88,6 +88,10 @@ HOT_PATH_ENTRIES = {
         "InflightRing.make_room", "InflightRing.admit",
         "InflightRing.discard"),
     "mxnet_tpu/kvstore.py": ("KVStore.push_bucketed",),
+    # serving engine: the per-step decode dispatch body — chains device
+    # state through the compiled step and admits the lazy token handle;
+    # a host sync here would serialize the whole serving pipeline
+    "mxnet_tpu/serving/engine.py": ("ServingEngine._dispatch_step",),
 }
 
 # the shard_map_compat shim's home — the ONLY file allowed to touch
