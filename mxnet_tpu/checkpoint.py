@@ -43,6 +43,14 @@ __all__ = ["AsyncCheckpointer", "load_checkpoint_state", "restore",
 _LOG = logging.getLogger("mxnet_tpu.checkpoint")
 
 
+def _is_step_target(obj) -> bool:
+    """Duck-type check for a ``DataParallelStep``-like target: owns
+    sharded state (``state_dict``/``load_state_dict``) plus a
+    :meth:`layout` describing its placement."""
+    return (hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict")
+            and hasattr(obj, "layout"))
+
+
 def _snapshot_params(net_or_params) -> Dict[str, np.ndarray]:
     """Host-side copy keyed by STRUCTURAL names when a Block is given
     ('0.weight', 'body.1.bias' — scope-independent, so a fresh process
@@ -57,6 +65,24 @@ def _snapshot_params(net_or_params) -> Dict[str, np.ndarray]:
     for name, p in params.items():
         out[name] = p.data().asnumpy().copy()
     return out
+
+
+def _snapshot_target(target, allow_collective: bool = True):
+    """(host params, host optimizer slots or None, layout or None) for a
+    checkpoint target — a Gluon Block / params dict (legacy shape,
+    layout-free: those snapshots are full replicated host arrays and are
+    world-size independent by construction), or a ``DataParallelStep``,
+    whose sharded state gathers through its own ``state_dict`` and whose
+    save-time :meth:`layout` travels into ``meta.json`` so a restore on
+    a different mesh knows it must reshard.  ``allow_collective=False``
+    (the rank-local preemption path) makes a gather-requiring snapshot
+    raise instead of hanging a one-rank collective."""
+    if _is_step_target(target):
+        state = target.state_dict(allow_collective=allow_collective)
+        layout = target.layout()
+        layout["optimizer"] = state.get("optimizer")
+        return state["params"], state.get("opt_state"), layout
+    return _snapshot_params(target), None, None
 
 
 class AsyncCheckpointer:
@@ -75,15 +101,32 @@ class AsyncCheckpointer:
     numbering from the latest checkpoint (otherwise a resumed run's
     step-N dirs would collide with and rotate against stale pre-crash
     ones); pass initial_step to override.
+
+    ``params`` may also be a :class:`~mxnet_tpu.parallel.DataParallelStep`:
+    its sharded params AND optimizer state snapshot to host (optimizer
+    slots land in ``opt_state.nd``) and its sharding layout (mesh shape,
+    per-param PartitionSpecs, world size) is recorded in ``meta.json`` —
+    the metadata ``restore()`` needs to reshard the state onto a
+    different mesh after an elastic gang resize
+    (docs/FAULT_TOLERANCE.md §Elastic resize).
+
+    ``writer=False`` makes this rank a NON-WRITING member of a gang that
+    shares ONE checkpoint directory (rank 0 writes, peers read): step
+    counting, heartbeats, and the chaos-harness hooks still run, and a
+    due snapshot is still TAKEN (a sharded ``state_dict``'s allgather
+    must stay lockstep across the gang) but never persisted or pruned —
+    without this, N ranks racing rename-into-place on shared storage
+    would tear each other's publishes.
     """
 
     def __init__(self, directory: str, save_every: int = 100, keep: int = 2,
-                 initial_step: Optional[int] = None):
+                 initial_step: Optional[int] = None, writer: bool = True):
         if save_every < 1:
             raise MXNetError("save_every must be >= 1")
         self.dir = directory
         self.save_every = save_every
         self.keep = keep
+        self.writer = bool(writer)
         os.makedirs(directory, exist_ok=True)
         if initial_step is None:
             # continue numbering from the newest step on disk; a torn
@@ -92,13 +135,15 @@ class AsyncCheckpointer:
             # step-* dir names when it is unreadable
             candidates = _candidate_steps(directory)
             initial_step = candidates[0] if candidates else 0
-        else:
+        elif self.writer:
             # explicit resume step (gang-agreed): step dirs ABOVE it are
             # an abandoned timeline — e.g. the previous incarnation's
             # preemption checkpoint the gang agreed NOT to resume from.
             # Left in place they would poison rotation ("newest" by
             # number) and latest_valid_step would resurrect them after
             # the next crash, restoring state this run never reached.
+            # (Non-writer ranks of a shared-dir gang never delete: the
+            # one writer owns the timeline.)
             for s in _candidate_steps(directory):
                 if s > initial_step:
                     shutil.rmtree(os.path.join(directory, f"step-{s}"),
@@ -111,23 +156,28 @@ class AsyncCheckpointer:
             except (OSError, ValueError):
                 pass
         self._step = int(initial_step)
-        # garbage-collect staging leftovers a crashed writer left behind
-        for d in os.listdir(directory):
-            if d.startswith(".tmp-"):
-                shutil.rmtree(os.path.join(directory, d), ignore_errors=True)
-            elif d.startswith(".latest.tmp"):
-                try:
-                    os.remove(os.path.join(directory, d))
-                except OSError:
-                    pass
+        if self.writer:
+            # garbage-collect staging leftovers a crashed writer left behind
+            for d in os.listdir(directory):
+                if d.startswith(".tmp-"):
+                    shutil.rmtree(os.path.join(directory, d),
+                                  ignore_errors=True)
+                elif d.startswith(".latest.tmp"):
+                    try:
+                        os.remove(os.path.join(directory, d))
+                    except OSError:
+                        pass
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: Optional[BaseException] = None
         self._closed = False
         # live-array census: queued host snapshots are the "checkpoint"
         # category (host bytes — the params were copied off device)
         memwatch.register("checkpoint", self, _queued_snapshot_arrays)
-        self._writer = threading.Thread(target=self._writer_loop, daemon=True)
-        self._writer.start()
+        self._writer = None
+        if self.writer:
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            daemon=True)
+            self._writer.start()
 
     # ------------------------------------------------------------------
     def step(self, params, trainer=None, extra: Optional[dict] = None) -> bool:
@@ -147,9 +197,22 @@ class AsyncCheckpointer:
         memwatch.on_step(self._step)
         if self._step % self.save_every != 0:
             return False
+        if not self.writer:
+            # non-writer rank of a shared-dir gang: participate in the
+            # snapshot ONLY when it runs a lockstep collective (a
+            # cross-process-sharded state_dict's allgather must match on
+            # every rank) — the common replicated/addressable case skips
+            # the full D2H sweep this rank would only discard
+            needs = getattr(params, "snapshot_requires_collective", None)
+            if needs is not None and needs():
+                _snapshot_target(params)
+            return False
+        host_params, opt, layout = _snapshot_target(params)
         snap = {
             "step": self._step,
-            "params": _snapshot_params(params),
+            "params": host_params,
+            "opt": opt,
+            "layout": layout,
             "trainer": None,
             "rng": self._rng_state(),
             "extra": extra or {},
@@ -163,6 +226,8 @@ class AsyncCheckpointer:
 
     def wait(self) -> None:
         """Block until all enqueued checkpoints are on disk."""
+        if self._writer is None:
+            return  # non-writer rank: nothing can be in flight
         self._queue.join()
         if self._error is not None:
             raise MXNetError(f"checkpoint writer failed: {self._error}")
@@ -181,8 +246,9 @@ class AsyncCheckpointer:
         try:
             self.wait()
         finally:
-            self._queue.put(None)
-            self._writer.join()
+            if self._writer is not None:
+                self._queue.put(None)
+                self._writer.join()
 
     def save_now(self, params, trainer=None, extra: Optional[dict] = None,
                  drain_timeout: float = 5.0) -> int:
@@ -197,12 +263,21 @@ class AsyncCheckpointer:
         drained by a bounded lock-free poll of unfinished_tasks instead;
         on timeout we write anyway: staging dirs are thread-unique, a
         same-step double publish is two snapshots of identical logical
-        state, and validation tolerates a racy `latest`."""
-        if self._step == 0:
+        state, and validation tolerates a racy `latest`.
+
+        Non-writer ranks (shared-dir gangs) return 0 without snapshotting:
+        SIGTERM is rank-local, so a collective gather here could never be
+        assumed lockstep — and the writer rank's own preemption save
+        covers the gang."""
+        if self._step == 0 or not self.writer:
             return 0
+        host_params, opt, layout = _snapshot_target(params,
+                                                    allow_collective=False)
         snap = {
             "step": self._step,
-            "params": _snapshot_params(params),
+            "params": host_params,
+            "opt": opt,
+            "layout": layout,
             "trainer": (self._trainer_states(trainer)
                         if trainer is not None else None),
             "rng": self._rng_state(),
@@ -283,6 +358,14 @@ class AsyncCheckpointer:
                       {k: nd.array(v, dtype=v.dtype)
                        for k, v in snap["params"].items()})
         digests["params.nd"] = _sha256_file(os.path.join(tmp, "params.nd"))
+        if snap.get("opt") is not None:
+            # optimizer slots of a DataParallelStep target (momenta /
+            # Adam moments), host-gathered like the params
+            nd_utils.save(os.path.join(tmp, "opt_state.nd"),
+                          {k: nd.array(v, dtype=v.dtype)
+                           for k, v in snap["opt"].items()})
+            digests["opt_state.nd"] = _sha256_file(
+                os.path.join(tmp, "opt_state.nd"))
         if snap["trainer"] is not None:
             with open(os.path.join(tmp, "trainer.states"), "wb") as f:
                 f.write(snap["trainer"])
@@ -291,10 +374,18 @@ class AsyncCheckpointer:
         fault.on_write_mid(step)
         # meta.json is written LAST and carries the payload digests: a
         # parseable meta whose digests verify is the definition of a
-        # valid checkpoint (load_checkpoint_state)
+        # valid checkpoint (load_checkpoint_state).  `layout` is the
+        # save-time sharding layout (mesh shape, per-param
+        # PartitionSpecs, world size) — what restore() compares against
+        # the restoring mesh to decide whether to reshard (elastic gang
+        # resize, docs/FAULT_TOLERANCE.md §Elastic resize).
+        meta = {"step": step, "rng": snap["rng"],
+                "extra": snap["extra"], "digests": digests}
+        if snap.get("layout") is not None:
+            meta["layout"] = snap["layout"]
+            meta["world_size"] = snap["layout"].get("world_size")
         with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump({"step": step, "rng": snap["rng"],
-                       "extra": snap["extra"], "digests": digests}, f)
+            json.dump(meta, f)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
@@ -448,7 +539,9 @@ def agree_resume_step(local_step: int, kv=None) -> int:
 
 def load_checkpoint_state(directory: str, step: Optional[int] = None):
     """Load the newest VALID checkpoint: dict(step, params (name->NDArray),
-    trainer (bytes or None), extra) — or None when no valid one exists.
+    opt_state (name->NDArray or None), trainer (bytes or None), extra,
+    layout (the save-time sharding layout, or None for Block-style
+    checkpoints)) — or None when no valid one exists.
     Restores the RNG key as a side effect (reference gap closed).
 
     Integrity: a candidate whose meta.json is torn, whose digests
@@ -494,6 +587,21 @@ def _load_checkpoint_state(directory: str, step: Optional[int] = None):
             telemetry.record_checkpoint("fallback", step=s,
                                         reason="payload-decode")
             continue
+        opt_state = None
+        opath = os.path.join(d, "opt_state.nd")
+        if os.path.exists(opath):
+            try:
+                opt_state = nd_utils.load(opath)
+            except Exception as e:  # same fallback contract as params.nd
+                if explicit:
+                    raise MXNetError(
+                        f"checkpoint step {s} in {directory} failed to "
+                        f"load optimizer state: {e}") from e
+                _LOG.warning("checkpoint %s optimizer state failed to load "
+                             "(%s); falling back", d, e)
+                telemetry.record_checkpoint("fallback", step=s,
+                                            reason="payload-decode")
+                continue
         trainer_states = None
         tpath = os.path.join(d, "trainer.states")
         if os.path.exists(tpath):
@@ -508,8 +616,9 @@ def _load_checkpoint_state(directory: str, step: Optional[int] = None):
                 np.asarray(meta["rng"], np.uint32))
         telemetry.record_checkpoint("load", step=s,
                                     wall_s=time.perf_counter() - t0)
-        return {"step": s, "params": params, "trainer": trainer_states,
-                "extra": meta.get("extra", {})}
+        return {"step": s, "params": params, "opt_state": opt_state,
+                "trainer": trainer_states, "extra": meta.get("extra", {}),
+                "layout": meta.get("layout")}
     return None
 
 
@@ -518,10 +627,25 @@ def restore(directory: str, net, trainer=None,
     """Apply the newest valid checkpoint (or exactly ``step=N``) to `net`
     (structural names) and `trainer`; restores the RNG key.  Returns the
     restored step (0 when no valid checkpoint exists) — the working end of
-    the resume recipe."""
+    the resume recipe.
+
+    ``net`` may also be a :class:`~mxnet_tpu.parallel.DataParallelStep`:
+    its params AND optimizer slots restore onto the step's CURRENT mesh,
+    **resharding** when the checkpoint's recorded layout (mesh shape,
+    per-param PartitionSpecs, device assignment, world size) differs from
+    the restoring one — the elastic N->M resume path, shrink and grow
+    alike (docs/FAULT_TOLERANCE.md §Elastic resize).  Each rank
+    materializes only the shards it now owns."""
     state = load_checkpoint_state(directory, step=step)
     if state is None:
         return 0
+    if _is_step_target(net):
+        host = {"params": {k: v.asnumpy()
+                           for k, v in state["params"].items()},
+                "opt_state": {k: v.asnumpy()
+                              for k, v in (state["opt_state"] or {}).items()}}
+        net.load_state_dict(host, saved_layout=state.get("layout"))
+        return state["step"]
     params = net._collect_params_with_prefix() if hasattr(
         net, "_collect_params_with_prefix") else dict(net)
     for name, p in params.items():

@@ -94,6 +94,16 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_RESTART_COUNT": (
         "honored", "gang incarnation index exported by tools/launch.py "
         "--max-restarts; read by fault.py if-restart= and resume logic"),
+    "MX_ELASTIC": (
+        "honored", "exported (=1) to workers by tools/launch.py --elastic "
+        "so they know the supervisor may re-rendezvous them at a "
+        "different world size (docs/FAULT_TOLERANCE.md §Elastic resize)"),
+    "MX_PREV_NUM_PROCS": (
+        "honored", "previous world size, exported by the --elastic "
+        "supervisor on the FIRST incarnation after a gang resize; "
+        "parallel/dist.py records the telemetry `resize` event off it "
+        "(the segment marker trace_report/mem_report key on) and worker "
+        "resume logic knows the restored checkpoint needs resharding"),
     # launcher contract (tools/launch.py exports; parallel/dist.py reads) —
     # TPU-native spellings of the DMLC_* variables above
     "MX_COORDINATOR": (

@@ -13,8 +13,9 @@ Fault spec grammar (``MX_FAULT_SPEC``, ';'-separated specs)::
 
     spec       := kind (":" key "=" value)*
     kind       := "crash" | "crash-write" | "torn-write" | "slow-write"
-                | "oom"
+                | "oom" | "crash-rendezvous"
     key        := "step" | "ms" | "file" | "rank" | "if-restart"
+                | "if-world"
 
   crash:step=N        hard os._exit(EXIT_INJECTED_CRASH) when the training
                       step counter reaches N (before N's checkpoint is
@@ -33,12 +34,21 @@ Fault spec grammar (``MX_FAULT_SPEC``, ';'-separated specs)::
                       fsync; file=meta|params|all (default all) picks which
   slow-write:ms=M     sleep M ms at the start of every checkpoint write
                       (step=N restricts it to one write)
+  crash-rendezvous    die DURING the gang rendezvous (parallel/dist.py
+                      calls on_rendezvous right before
+                      jax.distributed.initialize) — the re-rendezvous
+                      failure shape of an elastic resize; no step=
 
 Qualifiers on any spec: ``rank=R`` fires only on that worker
-(MX_PROC_ID/DMLC_WORKER_ID) and ``if-restart=K`` only on gang incarnation
-K (MX_RESTART_COUNT, exported by tools/launch.py --max-restarts) — so
-``crash:step=30:rank=1:if-restart=0`` kills rank 1 on the first attempt
-and lets the restarted gang run clean.
+(MX_PROC_ID/DMLC_WORKER_ID), ``if-restart=K`` only on gang incarnation
+K (MX_RESTART_COUNT, exported by tools/launch.py --max-restarts), and
+``if-world=N`` only when the gang's world size (MX_NUM_PROCS/
+DMLC_NUM_WORKER) is N — so ``crash:step=30:rank=1:if-restart=0`` kills
+rank 1 on the first attempt and lets the restarted gang run clean, and
+``crash:step=30:rank=2:if-world=3`` kills rank 2 *permanently at world
+size 3* (every incarnation) while letting an elastic resize to 2 ranks
+(tools/launch.py --elastic) run clean — the scriptable "lost host"
+(docs/FAULT_TOLERANCE.md §Elastic resize).
 """
 from __future__ import annotations
 
@@ -59,25 +69,29 @@ EXIT_INJECTED_CRASH = 57
 # tools/launch.py hard-codes the same value (it must not import jax).
 EXIT_PREEMPTED = 83
 
-_KINDS = ("crash", "crash-write", "torn-write", "slow-write", "oom")
-_KEYS = ("step", "ms", "file", "rank", "if-restart")
+_KINDS = ("crash", "crash-write", "torn-write", "slow-write", "oom",
+          "crash-rendezvous")
+_KEYS = ("step", "ms", "file", "rank", "if-restart", "if-world")
 
 
 class Fault:
     """One parsed fault: kind + trigger qualifiers."""
 
-    __slots__ = ("kind", "step", "ms", "file", "rank", "if_restart")
+    __slots__ = ("kind", "step", "ms", "file", "rank", "if_restart",
+                 "if_world")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  ms: Optional[int] = None, file: str = "all",
                  rank: Optional[int] = None,
-                 if_restart: Optional[int] = None):
+                 if_restart: Optional[int] = None,
+                 if_world: Optional[int] = None):
         self.kind = kind
         self.step = step
         self.ms = ms
         self.file = file
         self.rank = rank
         self.if_restart = if_restart
+        self.if_world = if_world
 
     def __repr__(self):
         quals = [f"{k}={v}" for k in _KEYS
@@ -94,6 +108,11 @@ class Fault:
                 return False
         if self.if_restart is not None:
             if int(os.environ.get("MX_RESTART_COUNT", "0")) != self.if_restart:
+                return False
+        if self.if_world is not None:
+            w = os.environ.get("MX_NUM_PROCS",
+                               os.environ.get("DMLC_NUM_WORKER", "1"))
+            if int(w) != self.if_world:
                 return False
         return True
 
@@ -135,6 +154,11 @@ def parse_spec(text: str) -> List[Fault]:
             raise MXNetError(f"MX_FAULT_SPEC: {f.kind} requires step=N")
         if f.kind == "slow-write" and f.ms is None:
             raise MXNetError("MX_FAULT_SPEC: slow-write requires ms=N")
+        if f.kind == "crash-rendezvous" and f.step is not None:
+            raise MXNetError(
+                "MX_FAULT_SPEC: crash-rendezvous fires at rendezvous time, "
+                "before any training step exists — step= does not apply "
+                "(scope it with rank=/if-restart=/if-world=)")
         faults.append(f)
     return faults
 
@@ -188,6 +212,20 @@ def on_dispatch(step: int) -> None:
             f"RESOURCE_EXHAUSTED: injected device OOM at step {step} "
             f"(MX_FAULT_SPEC): out of memory while allocating step "
             f"buffers")
+
+
+def on_rendezvous() -> None:
+    """``crash-rendezvous`` injection point — ``parallel.dist`` calls this
+    right before ``jax.distributed.initialize``, so an elastic
+    re-rendezvous (tools/launch.py --elastic) can be made to fail on a
+    chosen rank/incarnation/world size.  Scoped with ``if-world=N`` it
+    models a host that comes back broken: admitted into the resized gang
+    but dead before the coordination service ever sees it."""
+    f = _match("crash-rendezvous")
+    if f is not None:
+        print("mxnet_tpu.fault: injected crash during rendezvous",
+              flush=True)
+        os._exit(EXIT_INJECTED_CRASH)
 
 
 def on_write_begin(step: int) -> None:

@@ -116,30 +116,119 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """Iterator over in-memory arrays (reference ~L600)."""
+    """Iterator over in-memory arrays (reference ~L600).
+
+    TPU-native extensions over the reference iterator
+    (docs/FAULT_TOLERANCE.md §Elastic resize):
+
+    * ``seed`` — a per-iterator RNG.  The reference shuffled through the
+      *global* ``np.random`` state, so two interleaved iterators
+      perturbed each other and a restarted run could never reproduce an
+      epoch's order.  Here each epoch's permutation is derived from
+      ``(seed, epoch)`` alone, so the order is reproducible across
+      process restarts (the prerequisite for the checkpointable cursor).
+      ``seed=None`` draws one from the global stream at construction
+      (legacy ``np.random.seed`` determinism preserved) and records it in
+      :meth:`get_state` — even an unseeded iterator restores exactly.
+    * ``num_parts`` / ``part_index`` — gang sharding over ONE global
+      sample order (the ``ImageRecordIter`` contract): every rank holds
+      the full arrays, each global batch is ``batch_size * num_parts``
+      consecutive samples of the epoch permutation, and rank ``p`` takes
+      its ``batch_size`` slice.  The cursor counts GLOBAL samples, so it
+      is world-size independent: after an elastic resize the restored
+      iterator continues at the same sample position under the new
+      ``(num_parts, batch_size)`` — no sample skipped or consumed twice
+      even though the per-rank shard boundaries moved.
+    * :meth:`get_state` / :meth:`set_state` — the checkpointable position
+      (epoch, seed, global sample cursor), saved alongside the model via
+      ``AsyncCheckpointer.step(..., extra=...)``.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None,
+                 num_parts=1, part_index=0):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
-        self.idx = np.arange(self._size())
-        if shuffle:
-            np.random.shuffle(self.idx)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise MXNetError(
+                f"need 0 <= part_index < num_parts, got part_index="
+                f"{part_index} num_parts={num_parts}")
+        if num_parts > 1 and last_batch_handle == "roll_over":
+            # a short final global batch would hand higher-index parts an
+            # empty/shorter slice than their peers — divergent shapes into
+            # a sync-SGD collective step; gang sharding supports pad (wrap)
+            # and discard, whose per-part shapes stay uniform
+            raise MXNetError(
+                "num_parts > 1 does not support last_batch_handle="
+                "'roll_over' (ragged per-rank final batches); use 'pad' "
+                "or 'discard'")
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        self._stride = batch_size * self.num_parts
         self.shuffle = shuffle
+        if seed is None:
+            if shuffle and self.num_parts > 1:
+                # each rank drawing its own seed would shard DIFFERENT
+                # permutations — samples consumed twice/never with no
+                # error; the gang contract requires one agreed seed
+                raise MXNetError(
+                    "num_parts > 1 with shuffle requires an explicit "
+                    "seed: every rank must shard ONE global sample order")
+            # drawn from the global stream so legacy global-seed setups
+            # stay deterministic; recorded in get_state so restores
+            # reproduce the order either way
+            seed = int(np.random.randint(0, 2**31 - 1)) if shuffle else 0
+        self._seed = int(seed)
+        self._epoch = 0
         self.last_batch_handle = last_batch_handle
-        self.cursor = -batch_size
+        self.idx = self._perm()
+        self.cursor = -self._stride
         num = self._size()
         if last_batch_handle == "discard":
-            self.num_data = (num // batch_size) * batch_size
+            self.num_data = (num // self._stride) * self._stride
         else:
             self.num_data = num
 
     def _size(self):
         k, v = self.data[0]
         return len(v)
+
+    def _perm(self):
+        """This epoch's sample order — a pure function of (seed, epoch),
+        never of global RNG state or of how many batches were drawn."""
+        n = self._size()
+        if not self.shuffle:
+            return np.arange(n)
+        return np.random.default_rng((self._seed, self._epoch)).permutation(n)
+
+    # -- checkpointable position (docs/FAULT_TOLERANCE.md §Elastic resize) --
+    def get_state(self) -> dict:
+        """JSON-serializable iterator position: (epoch, seed, global
+        sample cursor).  The cursor counts samples consumed by ALL parts
+        jointly, so the state restores onto a different
+        ``(num_parts, batch_size)`` split — the elastic-resize contract."""
+        return {"epoch": int(self._epoch), "seed": int(self._seed),
+                "sample_cursor": int(max(0, self.cursor + self._stride)),
+                "shuffle": bool(self.shuffle),
+                "num_data": int(self._size())}
+
+    def set_state(self, state: dict) -> None:
+        """Resume exactly where :meth:`get_state` left off — the next
+        batch starts at the saved global sample position under THIS
+        iterator's stride, on the same (seed, epoch) permutation."""
+        if int(state.get("num_data", self._size())) != self._size():
+            raise MXNetError(
+                f"iterator state was saved over {state.get('num_data')} "
+                f"samples but this iterator holds {self._size()} — "
+                "restore requires the same dataset")
+        self._seed = int(state["seed"])
+        self.shuffle = bool(state.get("shuffle", self.shuffle))
+        self._epoch = int(state["epoch"])
+        self.idx = self._perm()
+        self.cursor = int(state["sample_cursor"]) - self._stride
 
     @property
     def provide_data(self):
@@ -154,30 +243,49 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
-        if self.shuffle:
-            np.random.shuffle(self.idx)
-        self.cursor = -self.batch_size
+        self._epoch += 1  # a fresh (seed, epoch) permutation each epoch
+        self.idx = self._perm()
+        self.cursor = -self._stride
 
     def iter_next(self):
-        self.cursor += self.batch_size
+        self.cursor += self._stride
+        if self.last_batch_handle == "discard":
+            # the FULL global window must fit: a restored cursor may not
+            # be aligned to THIS stride (set_state after a resize), and a
+            # straddling window would hand ranks ragged/empty batches —
+            # discard means fixed shapes, so the short tail is dropped
+            return self.cursor + self._stride <= self._size()
         return self.cursor < self.num_data
+
+    def _sel(self):
+        """This part's sample ids for the current global batch: the
+        ``batch_size`` slice at ``part_index`` inside the
+        ``batch_size * num_parts`` global window at ``cursor``.  In pad
+        mode a window reaching past the epoch wraps circularly over the
+        permutation (the reference's wrap-from-the-head, generalized to
+        parts)."""
+        offset = self.cursor + self.part_index * self.batch_size
+        end = offset + self.batch_size
+        # discard windows are guaranteed by iter_next to fit the RAW
+        # size (a restored cursor may be unaligned, so a full window can
+        # legitimately reach past the stride-aligned num_data)
+        limit = self._size() if self.last_batch_handle == "discard" \
+            else self.num_data
+        if end <= limit:
+            return self.idx[offset:end]
+        if self.last_batch_handle == "pad":
+            return self.idx[np.arange(offset, end) % self.num_data]
+        return self.idx[offset:limit]  # roll_over: short part
 
     def _take(self, arrays):
         from .. import ndarray as nd
         from ..ndarray import NDArray
 
+        sel = self._sel()
         out = []
         for _, v in arrays:
             vnp = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-            end = self.cursor + self.batch_size
-            sel = self.idx[self.cursor: end]
             part = vnp[sel]
-            if len(part) < self.batch_size:  # pad by wrapping
-                if self.last_batch_handle == "pad":
-                    extra = vnp[self.idx[: self.batch_size - len(part)]]
-                    part = np.concatenate([part, extra])
-                elif self.last_batch_handle == "roll_over":
-                    pass
             out.append(nd.array(part, dtype=part.dtype))
         return out
 
@@ -187,11 +295,20 @@ class NDArrayIter(DataIter):
     def getlabel(self):
         return self._take(self.label)
 
+    def getindex(self):
+        """Sample ids of this part's current batch (the census surface:
+        summing getindex over ranks and steps must cover an epoch exactly
+        once — asserted across an elastic resize in tests/test_elastic.py)."""
+        if self.cursor < 0:
+            return None
+        return self._sel().copy()
+
     def getpad(self):
-        if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
-        return 0
+        if self.last_batch_handle != "pad":
+            return 0
+        offset = self.cursor + self.part_index * self.batch_size
+        pad = offset + self.batch_size - self.num_data
+        return max(0, min(self.batch_size, pad))
 
 
 class ResizeIter(DataIter):

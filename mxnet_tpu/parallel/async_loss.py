@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import threading
 import time
 import weakref
@@ -370,10 +371,22 @@ def drain_all():
     """Drain every live ring in the process (preemption/checkpoint paths).
     Best-effort: deferred failures are collected and returned, not raised —
     the caller is usually about to snapshot-and-exit and must not die on a
-    step that was doomed anyway."""
+    step that was doomed anyway.
+
+    Buffered-but-undispatched superstep groups are flushed FIRST (via the
+    ``data_parallel`` step registry): they were never admitted to any
+    ring, so draining alone would silently drop up to K-1 enqueued steps
+    from a SIGTERM preemption's final checkpoint.  sys.modules lookup,
+    not import — this runs inside a signal handler."""
+    errors = []
+    dp = sys.modules.get("mxnet_tpu.parallel.data_parallel")
+    if dp is not None:
+        try:
+            errors.extend(dp.flush_all_steps())
+        except Exception as exc:  # noqa: BLE001 — survey, don't die
+            errors.append(exc)
     with _rings_lock:
         rings = list(_live_rings)
-    errors = []
     for ring in rings:
         try:
             ring.drain()

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -30,7 +31,29 @@ from .async_loss import (AsyncLoss, InflightRing, StackedAsyncLoss,
                          SuperstepLossView, inflight_limit)
 from .sharding import ShardingRules, replicated, shard_batch
 
-__all__ = ["DataParallelStep", "make_train_step", "superstep_k"]
+__all__ = ["DataParallelStep", "make_train_step", "superstep_k",
+           "flush_all_steps"]
+
+# every live step object in the process, so preemption paths can flush
+# buffered-but-undispatched superstep groups they never saw (weak: the
+# registry must not keep a dropped step alive)
+_live_steps: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def flush_all_steps() -> List[BaseException]:
+    """Dispatch every live step's buffered partial superstep group
+    (best-effort, errors collected not raised).  The SIGTERM preemption
+    path runs this BEFORE ``async_loss.drain_all``: a buffered
+    ``_SuperstepGroup`` was never dispatched, so draining the in-flight
+    rings alone would silently drop up to K-1 enqueued steps from the
+    final sync checkpoint (the PR 9 known issue)."""
+    errors: List[BaseException] = []
+    for step in list(_live_steps):
+        try:
+            step.flush()
+        except BaseException as exc:  # noqa: BLE001 — survey, don't die
+            errors.append(exc)
+    return errors
 
 
 def superstep_k(mesh=None) -> int:
@@ -361,10 +384,16 @@ class DataParallelStep:
         # never run memory/analysis APIs, mxlint hot-sync) stamps what it
         # knows at the traced call; step() hands it to memwatch after
         self._pending_compile: Optional[Dict[str, Any]] = None
+        # compiled allgather for state_dict's sharded->host baseline,
+        # built lazily once per step object
+        self._gather_jit = None
         # live-array census attribution (docs/OBSERVABILITY.md §Memory):
         # weak registration — the watchdog never keeps this step alive
         memwatch.register("params", self, _params_arrays)
         memwatch.register("optimizer", self, _opt_state_arrays)
+        # preemption paths flush buffered superstep groups via this
+        # process-wide registry (flush_all_steps)
+        _live_steps.add(self)
 
     def _ensure_state(self, example_inputs):
         """Gather params (resolving deferred init via one eager forward) and
@@ -1266,6 +1295,259 @@ class DataParallelStep:
         for name, p in self._param_items:
             host = np.asarray(jax.device_get(self.params[name]))
             p.set_data(host)
+
+    # ------------------------------------------------------------------
+    # checkpointable sharded state (docs/FAULT_TOLERANCE.md §Elastic
+    # resize): the save-time layout travels with the snapshot so a
+    # restore onto a DIFFERENT mesh (N->M ranks, or a reordered device
+    # assignment) reshards instead of silently mis-placing shards.
+    # ------------------------------------------------------------------
+    def _struct_names(self) -> Dict[str, str]:
+        """collect_params name -> structural name ('0.weight'), the
+        scope-independent scheme checkpoints key on (a fresh process's
+        gluon name counters may differ); identity mapping when the block
+        doesn't expose structural names."""
+        if not hasattr(self.block, "_collect_params_with_prefix"):
+            return {n: n for n, _ in self._param_items}
+        by_param = {id(p): sname for sname, p in
+                    self.block._collect_params_with_prefix().items()}
+        return {n: by_param.get(id(p), n) for n, p in self._param_items}
+
+    def layout(self) -> dict:
+        """JSON-serializable sharding layout of this step's training
+        state: world size, mesh axes, the mesh's device assignment, and
+        each parameter's PartitionSpec — what ``checkpoint.py`` records
+        in ``meta.json`` and what ``load_state_dict`` compares against
+        the current mesh to decide whether a restore must reshard."""
+        import jax
+
+        specs = {}
+        if self._shardings is not None:
+            smap = self._struct_names()
+            for name, sh in self._shardings.items():
+                specs[smap.get(name, name)] = [
+                    list(a) if isinstance(a, tuple) else a
+                    for a in tuple(sh.spec)]
+        return {
+            "world_size": int(jax.process_count()),
+            "mesh_axes": [[n, int(s)] for n, s in self.mesh.shape.items()],
+            "device_ids": [int(d.id) for d in self.mesh.devices.flat],
+            "platform": next(iter(self.mesh.devices.flat)).platform,
+            "specs": specs,
+        }
+
+    def _to_host_full(self, arr, allow_collective: bool = True):
+        """Full (global) host value of a possibly-sharded array — the
+        gather-to-host correctness baseline of the resharding story.
+        Fully-addressable arrays read directly and fully-replicated ones
+        read their local shard (both collective-free, hence safe in the
+        SIGTERM preemption path); a genuinely sharded multi-process
+        array pays ONE compiled allgather (jit identity onto a
+        replicated out_sharding), so every rank must call in lockstep —
+        which scheduled checkpoints do by construction.
+        ``allow_collective=False`` (the preemption path, where only ONE
+        rank may be running this) raises instead of hanging the gather."""
+        import jax
+
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(arr))
+        if getattr(arr, "is_fully_replicated", False):
+            return np.asarray(arr.addressable_shards[0].data)
+        if not allow_collective:
+            raise MXNetError(
+                "state_dict: a cross-process-sharded array needs an "
+                "allgather, which a rank-local (preemption) snapshot must "
+                "not run — resume from the last scheduled checkpoint "
+                "instead")
+        if self._gather_jit is None:
+            # mxlint: disable=retrace-hazard — built once per step object
+            self._gather_jit = jax.jit(
+                lambda x: x, out_shardings=replicated(self.mesh))
+        rep = self._gather_jit(arr)
+        return np.asarray(rep.addressable_shards[0].data)
+
+    def snapshot_requires_collective(self) -> bool:
+        """Whether :meth:`state_dict` must run a gang-lockstep allgather
+        (any cross-process-sharded, non-replicated array).  Non-writer
+        ranks of a shared-dir gang consult this to skip building a full
+        host snapshot they would only discard — the common replicated-dp
+        case never needs their participation."""
+        import jax
+
+        arrs = list((self.params or {}).values())
+        arrs += jax.tree_util.tree_leaves(self.opt_state)
+        return any(
+            not getattr(a, "is_fully_addressable", True)
+            and not getattr(a, "is_fully_replicated", False)
+            for a in arrs)
+
+    def state_dict(self, allow_collective: bool = True) -> dict:
+        """Host snapshot of the sharded training state, keyed by
+        structural parameter names: ``{"params": {name: ndarray},
+        "opt_state": {slot.name: ndarray}, "optimizer": ...}``.  Flushes
+        any buffered superstep group first (a buffered step's update is
+        not in ``self.params`` yet) but does NOT force the in-flight
+        window — jax arrays are futures, and the host reads below block
+        on exactly the values the dispatched steps produce."""
+        if self.params is None:
+            raise MXNetError(
+                "state_dict: step holds no state yet (no step/stage ran)")
+        self.flush()
+        smap = self._struct_names()
+
+        def host(a):
+            return self._to_host_full(a, allow_collective=allow_collective)
+
+        params = {smap.get(n, n): host(a) for n, a in self.params.items()}
+        opt: Dict[str, np.ndarray] = {}
+        if self._optimizer == "sgd":
+            for n, a in self.opt_state.items():
+                opt[f"mom.{smap.get(n, n)}"] = host(a)
+        else:
+            import jax
+
+            means, vars_, t = self.opt_state
+            for n, a in means.items():
+                opt[f"mean.{smap.get(n, n)}"] = host(a)
+            for n, a in vars_.items():
+                opt[f"var.{smap.get(n, n)}"] = host(a)
+            opt["t"] = np.asarray(jax.device_get(t))
+        return {"params": params, "opt_state": opt,
+                "optimizer": self._optimizer}
+
+    def load_state_dict(self, state: dict,
+                        saved_layout: Optional[dict] = None) -> dict:
+        """Install a host state snapshot onto THIS step's mesh,
+        resharding when the save-time layout differs — the elastic
+        N->M resume path (shrink and grow alike).
+
+        Every parameter (and optimizer slot) is placed through
+        ``_global_put``, which materializes ONLY the shards addressable
+        to this process: on a resized or reordered mesh each rank moves
+        exactly the shard set it now owns, nothing else — the
+        shard-granular fast path over the gather-to-host baseline the
+        snapshot itself is.  When ``saved_layout`` matches the current
+        :meth:`layout` the placement is recorded as layout-stable (no
+        reshard telemetry); a world-size change additionally records a
+        ``resize`` event.  Returns an info dict (``resharded``,
+        ``old_world``, ``new_world``, ``n_params``)."""
+        saved_opt = state.get("optimizer") or (saved_layout or {}).get(
+            "optimizer")
+        if saved_opt and saved_opt != self._optimizer:
+            raise MXNetError(
+                f"checkpoint optimizer state was saved from a "
+                f"{saved_opt!r} step but this step runs "
+                f"{self._optimizer!r} — restoring would silently "
+                "zero-fill every optimizer slot")
+        params_host = state["params"]
+        smap = self._struct_names()
+        local_of = {v: k for k, v in smap.items()}
+        # serialized against a DevicePrefetchIter's background stage()
+        # racing first-use _ensure_state: whichever runs second must see
+        # the other's published state, never interleave half-built dicts
+        # (a late _ensure_state overwriting the restored params would
+        # silently resume from re-initialized weights)
+        with self._state_lock:
+            if self._shardings is None:
+                # fresh process, no step taken yet: build the shardings
+                # from the snapshot's shapes — restore must not require a
+                # warm-up step (it would advance the RNG and optimizer
+                # state)
+                shapes = {local_of.get(sname, sname): tuple(np.shape(v))
+                          for sname, v in params_host.items()}
+                self._shardings = self.rules.shardings(self.mesh, shapes)
+            cur = self.layout()
+            same = (saved_layout is not None
+                    and _layouts_equal(saved_layout, cur))
+            new_params = {}
+            for n, p in self._param_items:
+                sname = smap.get(n, n)
+                if sname not in params_host:
+                    raise MXNetError(
+                        f"checkpoint missing parameter {sname}")
+                host = np.asarray(params_host[sname])
+                new_params[n] = _global_put(host, self._shardings[n])
+                # keep the Gluon block in agreement (sync_to_block
+                # parity, and a later eager forward must see the
+                # restored weights)
+                p.set_data(host)
+            opt = dict(state.get("opt_state") or {})
+            if not opt:
+                # legitimate (a params-only / legacy Block checkpoint)
+                # but never silent: momentum/Adam moments restart at zero
+                import logging
+
+                logging.getLogger("mxnet_tpu.data_parallel").warning(
+                    "load_state_dict: checkpoint carries no optimizer "
+                    "state — resuming with FRESH (zeroed) %s slots",
+                    self._optimizer)
+
+            def slot(prefix, n):
+                sname = f"{prefix}.{smap.get(n, n)}"
+                if sname in opt:
+                    return np.asarray(opt[sname])
+                if opt:
+                    # a PARTIALLY missing slot is a renamed/mismatched
+                    # param, not a fresh start — zero-filling just this
+                    # one would silently corrupt the trajectory
+                    raise MXNetError(
+                        f"checkpoint optimizer state is missing slot "
+                        f"{sname!r} (has: {sorted(opt)[:8]}...)")
+                return np.zeros(np.shape(new_params[n]), np.float32)
+
+            if self._optimizer == "sgd":
+                opt_state = {
+                    n: _global_put(slot("mom", n), self._shardings[n])
+                    for n, _ in self._param_items}
+            else:
+                import jax.numpy as jnp
+
+                m = {n: _global_put(slot("mean", n), self._shardings[n])
+                     for n, _ in self._param_items}
+                v = {n: _global_put(slot("var", n), self._shardings[n])
+                     for n, _ in self._param_items}
+                t = jnp.asarray(int(np.asarray(opt.get("t", 0))),
+                                jnp.int32)
+                opt_state = (m, v, t)
+            # publish params LAST (the unlocked _ensure_state fast-path
+            # check)
+            self.opt_state = opt_state
+            self.params = new_params
+        old_world = (saved_layout or {}).get("world_size")
+        info = {"resharded": bool(saved_layout is not None and not same),
+                "old_world": old_world,
+                "new_world": cur["world_size"],
+                "n_params": len(new_params)}
+        if info["resharded"] and telemetry.enabled():
+            telemetry.record("reshard", executor=self._tele_name,
+                             n_params=len(new_params),
+                             old_world=old_world,
+                             new_world=cur["world_size"])
+            if old_world is not None and old_world != cur["world_size"] \
+                    and not os.environ.get("MX_ELASTIC") \
+                    and not os.environ.get("MX_PREV_NUM_PROCS"):
+                # the segment marker the report tools key on — but ONLY
+                # for manual (supervisor-less) resizes.  Under --elastic
+                # the rendezvous already recorded it off
+                # MX_PREV_NUM_PROCS, and a LATER same-size restart that
+                # re-restores the old-world checkpoint (died before its
+                # first post-resize save) must not mint a second marker
+                # for the same logical resize — the stream already
+                # carries the first incarnation's
+                telemetry.record("resize", old_world=old_world,
+                                 new_world=cur["world_size"],
+                                 source="restore")
+        return info
+
+
+def _layouts_equal(a: dict, b: dict) -> bool:
+    """Whether two :meth:`DataParallelStep.layout` descriptions denote the
+    SAME placement: world size, mesh axes, per-param specs AND the device
+    assignment — serialized executables and shard ownership both key on
+    device ids (the AOT-cache lesson), so a same-shape mesh over reordered
+    devices is a different layout."""
+    keys = ("world_size", "mesh_axes", "device_ids", "specs")
+    return all(a.get(k) == b.get(k) for k in keys)
 
 
 def make_train_step(block, loss_fn, mesh=None, **kwargs) -> DataParallelStep:

@@ -117,6 +117,7 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
 
     import logging
 
+    from .. import fault
     from .. import telemetry
 
     timeout = float(_env("MX_RENDEZVOUS_TIMEOUT", default="300"))
@@ -125,12 +126,17 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
     retries = 0
     while True:
         try:
+            # chaos harness: `crash-rendezvous` dies HERE — the elastic
+            # re-rendezvous failure shape (a re-admitted host that dials
+            # the fresh coordinator and drops dead)
+            fault.on_rendezvous()
             jax.distributed.initialize(
                 coordinator_address=coord, num_processes=n, process_id=rank,
                 initialization_timeout=max(
                     10, int(deadline - time.monotonic())))
             telemetry.record("rendezvous", coordinator=coord, nproc=n,
                              retries=retries)
+            _record_resize(n)
             return
         except (TypeError, ValueError):
             raise  # misconfiguration, deterministic — fail fast, no retry
@@ -162,6 +168,26 @@ def _initialize_with_retry(coord: str, n: int, rank: int) -> None:
                              retries=retries, error=str(e)[:200])
             time.sleep(min(delay, remaining))
             delay = min(delay * 2, 10.0)
+
+
+def _record_resize(n: int) -> None:
+    """One telemetry ``resize`` event when this incarnation follows an
+    elastic world-size change (tools/launch.py --elastic exports
+    MX_PREV_NUM_PROCS alongside the reduced/grown MX_NUM_PROCS).  The
+    event marks the segment boundary trace_report/mem_report use to keep
+    the post-resize recompile wall and the restart dead-time out of the
+    straggler/leak verdicts."""
+    from .. import telemetry
+
+    prev = _env("MX_PREV_NUM_PROCS")
+    try:
+        prev_n = int(prev) if prev else None
+    except ValueError:
+        return
+    if prev_n is not None and prev_n != n:
+        telemetry.record(
+            "resize", old_world=prev_n, new_world=n,
+            restart=int(_env("MX_RESTART_COUNT", default="0") or 0))
 
 
 def is_initialized() -> bool:

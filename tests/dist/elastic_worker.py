@@ -1,0 +1,125 @@
+"""Elastic gang resize worker (docs/FAULT_TOLERANCE.md §Elastic resize).
+
+Every rank drives ONE global dp mesh (one CPU device per process) through
+a ``DataParallelStep``; the checkpoint directory is SHARED — rank 0 is
+the writer, peers are non-writing members — and every checkpoint carries
+the sharded params, the optimizer slots, the save-time sharding layout,
+and the iterator position.  On (re)start each rank restores the
+gang-agreed scheduled step, **resharding** the snapshot onto the CURRENT
+world size, and rebuilds its ``NDArrayIter`` at the saved global sample
+cursor — training continues with no sample skipped or consumed twice,
+even though the global batch size changed with the world size.
+
+The parent test runs this same script as the elastic run (under
+``tools/launch.py --elastic``, shrunk by the chaos harness or grown by
+``--regrow-after``) AND as the fixed-size baseline (plain launch +
+``MX_RESUME_STEP``): final weights must match bitwise — a resize is
+trajectory-invisible past the resume point.
+
+env:
+  MX_ELASTIC_DIR         base dir: shared checkpoints under <dir>/ckpt,
+                         final weights at <dir>/final_<tag>.npz
+  MX_ELASTIC_TAG         name of this run's final-weights file
+  MX_RESUME_STEP         (baseline runs) demand exactly this resume step
+  MX_ELASTIC_STEP_SLEEP  per-step host sleep (stretches wall time so the
+                         supervisor's --regrow-after lands mid-run)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# one CPU device per process (a dp<world> global mesh) BEFORE jax
+# initializes: the pytest parent's XLA_FLAGS asks for 8 virtual devices
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402  (rendezvous runs at import)
+from mxnet_tpu import checkpoint, fault, gluon
+from mxnet_tpu.io.io import NDArrayIter
+from mxnet_tpu.parallel import DataParallelStep, make_mesh
+
+TOTAL = 60
+SAVE_EVERY = 5
+PER_RANK_BATCH = 4
+
+
+def main():
+    import jax
+
+    base = os.environ["MX_ELASTIC_DIR"]
+    tag = os.environ.get("MX_ELASTIC_TAG", "elastic")
+    sleep_s = float(os.environ.get("MX_ELASTIC_STEP_SLEEP", "0") or 0)
+    ckdir = os.path.join(base, "ckpt")
+    kv = mx.kv.create("dist_sync")
+    rank, world = kv.rank, kv.num_workers
+    mesh = make_mesh(devices=jax.devices())
+    assert mesh.shape["dp"] == world, (mesh.shape, world)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 4).astype(np.float32)
+    Y = (X @ np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)).astype(
+        np.float32)
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Normal(0.5))
+    step = DataParallelStep(
+        net, gluon.loss.L2Loss(), mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    # every rank feeds the same host-GLOBAL batch (the pjit pod-input
+    # pattern); the global batch scales with the world size so the
+    # per-device share stays fixed across resizes, and the cursor counts
+    # global samples so the position survives the stride change
+    it = NDArrayIter(X, Y, batch_size=PER_RANK_BATCH * world,
+                     shuffle=True, seed=7)
+
+    demand = os.environ.get("MX_RESUME_STEP")
+    local = checkpoint.latest_valid_step(ckdir, multiple_of=SAVE_EVERY)
+    start = checkpoint.agree_resume_step(local, kv)
+    if demand:
+        start = int(demand)
+    if start:
+        state = checkpoint.load_checkpoint_state(ckdir, step=start)
+        host = {
+            "params": {k: v.asnumpy() for k, v in state["params"].items()},
+            "opt_state": {k: v.asnumpy()
+                          for k, v in (state["opt_state"] or {}).items()},
+        }
+        info = step.load_state_dict(host, saved_layout=state.get("layout"))
+        it.set_state(state["extra"]["iter"])
+        print(f"elastic: rank {rank} resuming at step {start} world {world} "
+              f"resharded={info['resharded']} old_world={info['old_world']}",
+              flush=True)
+    ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=SAVE_EVERY,
+                                        keep=100, initial_step=start,
+                                        writer=(rank == 0))
+    fault.install_preemption_handler(ckpt, step)
+
+    loss = None
+    for _i in range(start, TOTAL):
+        try:
+            batch = it.next()
+        except StopIteration:
+            it.reset()
+            batch = it.next()
+        loss = step.step(batch.data[0], batch.label[0])
+        # force per step: crash/preemption points stay deterministic
+        loss = float(loss)
+        ckpt.step(step, extra={"iter": it.get_state()})
+        if sleep_s:
+            time.sleep(sleep_s)
+    step.drain()
+    ckpt.close()
+    weights = step.state_dict()["params"]
+    if rank == 0:
+        np.savez(os.path.join(base, f"final_{tag}.npz"), **weights)
+    kv.barrier()
+    print(f"elastic: rank {rank}/{world} done loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -624,3 +624,111 @@ def test_supervised_restart_with_warm_cache_resumes_bitwise(tmp_path):
     # then re-verify at resume — two prints are legitimate)
     assert "rank 1: matches uninterrupted baseline" in res.stdout
     assert "rank 0: matches uninterrupted baseline" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# preemption-path flush of buffered groups (ISSUE 12 satellite: the PR 9
+# known issue — drain_all() used to skip buffered-but-undispatched
+# _SuperstepGroup entries, silently dropping up to K-1 steps from a
+# SIGTERM's final sync checkpoint)
+# ---------------------------------------------------------------------------
+def test_drain_all_flushes_buffered_superstep_groups(monkeypatch):
+    """drain_all DISPATCHES an open partial group (as a shorter scan)
+    before draining the rings — the buffered steps land in the params
+    instead of vanishing."""
+    from mxnet_tpu.parallel import async_loss
+
+    batches = _batches(6)
+    base_l, base_w = _run_mode(monkeypatch, batches, 0)
+    monkeypatch.setenv("MX_SUPERSTEP", "4")
+    monkeypatch.setenv("MX_SUPERSTEP_FORCE_CPU", "1")
+    step = _build()
+    for x, y in batches:  # 4 dispatch as one group, 2 stay buffered
+        step.step(x, y)
+    assert step._open_group is not None \
+        and len(step._open_group.entries) == 2
+    errors = async_loss.drain_all()
+    assert errors == []
+    assert step._open_group is None or not step._open_group.entries
+    w = _weights(step)
+    for name in base_w:
+        assert np.array_equal(base_w[name], w[name]), name
+
+
+_PREEMPT_SUPERSTEP_WORKER = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["MX_SUPERSTEP"] = "4"
+os.environ["MX_SUPERSTEP_FORCE_CPU"] = "1"
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, fault, gluon, nd
+from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+ckdir = sys.argv[1]
+mx.random.seed(0)
+net = gluon.nn.Dense(4)
+net.initialize(mx.init.Xavier())
+step = DataParallelStep(net, gluon.loss.L2Loss(),
+                        mesh=local_mesh(devices=[jax.devices()[0]]),
+                        optimizer="sgd")
+ckpt = checkpoint.AsyncCheckpointer(ckdir, save_every=1000)
+fault.install_preemption_handler(ckpt, step)
+rng = np.random.RandomState(0)
+batches = [(nd.array(rng.rand(8, 4).astype(np.float32)),
+            nd.array(rng.rand(8, 4).astype(np.float32)))
+           for _ in range(6)]
+for x, y in batches:
+    step.step(x, y)
+    ckpt.step(step)
+# 4 steps dispatched as one scan; steps 5-6 still buffered when SIGTERM hits
+assert step._open_group is not None and len(step._open_group.entries) == 2
+open(os.path.join(ckdir, "ready"), "w").close()
+while True:
+    time.sleep(0.05)
+"""
+
+
+@pytest.mark.chaos
+def test_preemption_checkpoint_includes_buffered_superstep_steps(tmp_path):
+    """CHAOS acceptance for the satellite: SIGTERM lands with 2 of 6
+    steps still buffered in an open K=4 group; the final preemption
+    checkpoint must carry ALL 6 steps' updates (bitwise vs the 6-step
+    sequential oracle), not silently drop the buffered two."""
+    import signal
+    import subprocess as sp
+    import time as _time
+
+    from mxnet_tpu import checkpoint
+
+    # sequential oracle in-process
+    mp = pytest.MonkeyPatch()
+    try:
+        batches = _batches(6)
+        _l, oracle = _run_mode(mp, batches, 0)
+    finally:
+        mp.undo()
+
+    ckdir = tmp_path / "ck"
+    os.makedirs(ckdir)
+    script = tmp_path / "worker.py"
+    script.write_text(_PREEMPT_SUPERSTEP_WORKER.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = sp.Popen([sys.executable, str(script), str(ckdir)], env=env,
+                    stdout=sp.PIPE, stderr=sp.PIPE, text=True)
+    ready = ckdir / "ready"
+    deadline = _time.monotonic() + 240
+    while not ready.exists():
+        assert proc.poll() is None, proc.communicate()
+        assert _time.monotonic() < deadline, "worker never became ready"
+        _time.sleep(0.1)
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 83, (out, err[-2000:])
+    assert "final checkpoint at step 6" in out, (out, err[-1000:])
+    state = checkpoint.load_checkpoint_state(str(ckdir))
+    assert state["step"] == 6
+    for name in oracle:
+        got = state["params"][name].asnumpy()
+        assert np.array_equal(oracle[name], got), name

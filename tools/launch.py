@@ -295,6 +295,20 @@ class _HeartbeatMonitor:
             return None
         return rec if isinstance(rec, dict) else None
 
+    def any_started(self) -> bool:
+        """Whether any rank of THIS incarnation has heartbeat yet — arms
+        the elastic regrow countdown on observed worker progress rather
+        than on spawn (imports + rendezvous + restore would otherwise eat
+        a fixed-from-spawn deadline).  Always False without telemetry."""
+        if self.dir is None:
+            return False
+        for rank in range(self.num):
+            rec = self._read(rank)
+            if rec is not None and \
+                    float(rec.get("time", 0.0)) >= self._gang_start:
+                return True
+        return False
+
     def poll(self) -> None:
         """Called from the supervision loop while the gang is alive;
         reports each staleness episode once (and recovery resets it)."""
@@ -438,16 +452,36 @@ def _terminate_gang(procs, term_timeout: float = 10.0) -> None:
             pass
 
 
-def _wait_gang(procs, term_timeout: float, monitor=None) -> int:
+def _wait_gang(procs, term_timeout: float, monitor=None, regrow_after=None):
     """Poll ALL workers: a crash in any rank (not just the first) must fan
     out SIGTERM immediately, or the peers block forever in collectives
-    waiting for the dead rank.  Returns the first non-zero exit code (the
-    *cause*, not the exit of SIGTERMed peers), else 0; all procs reaped.
-    `monitor` (a _HeartbeatMonitor) is polled so a stale rank is called
-    out while the gang still looks alive."""
+    waiting for the dead rank.  Returns ``(rc, planned)``: rc is the
+    first non-zero exit code (the *cause*, not the exit of SIGTERMed
+    peers), else 0; all procs reaped.  `monitor` (a _HeartbeatMonitor)
+    is polled so a stale rank is called out while the gang still looks
+    alive.
+
+    ``regrow_after`` (seconds) is the elastic supervisor's planned-resize
+    trigger: after that long of healthy running the gang is SIGTERMed
+    (workers take their preemption checkpoints) and ``planned=True`` is
+    returned — a regrow, not a failure.  With telemetry heartbeats
+    available the countdown arms at the first beat of THIS incarnation
+    (imports/rendezvous/restore must not eat the budget); without, it
+    counts from spawn."""
     rc = 0
+    deadline = None
+    if regrow_after is not None and (monitor is None or monitor.dir is None):
+        deadline = time.monotonic() + regrow_after
     alive = list(procs)
     while alive:
+        if regrow_after is not None and deadline is None \
+                and monitor.any_started():
+            deadline = time.monotonic() + regrow_after
+        if (deadline is not None and regrow_after is not None and rc == 0
+                and len(alive) == len(procs)
+                and time.monotonic() >= deadline):
+            _terminate_gang(alive, term_timeout)
+            return 0, True
         for p in list(alive):
             r = p.poll()
             if r is None:
@@ -460,29 +494,80 @@ def _wait_gang(procs, term_timeout: float, monitor=None) -> int:
             if monitor is not None:
                 monitor.poll()
             time.sleep(0.05)
-    return rc
+    return rc, False
+
+
+def _culprit_count(codes) -> int:
+    """How many ranks of a dead gang look like the CAUSE rather than the
+    teardown consequence: a SIGTERMed peer exits EXIT_PREEMPTED (handled
+    preemption) or -SIGTERM/-SIGKILL (escalation), everything else —
+    injected crashes (57), tracebacks (1), sys.exit(n) — is a culprit.
+    At least 1: something killed the gang even if every exit looks like
+    a consequence (e.g. a whole-gang preemption storm)."""
+    culprits = sum(
+        1 for c in codes
+        if c not in (0, EXIT_PREEMPTED, -signal.SIGTERM, -signal.SIGKILL))
+    return max(1, culprits)
 
 
 def launch_local(num_workers: int, command, env_extra=None,
                  force_cpu: bool = False, max_restarts: int = 0,
-                 term_timeout: float = 10.0, backoff: float = 1.0) -> int:
+                 term_timeout: float = 10.0, backoff: float = 1.0,
+                 elastic: bool = False, min_workers: int = 1,
+                 initial_workers=None, regrow_after: float = 0.0) -> int:
     """Spawn num_workers processes of `command` on this host and supervise
     the gang: on any worker death the remaining ranks are torn down
     (SIGTERM, bounded wait, SIGKILL) and — up to max_restarts times — the
     whole gang is re-spawned on a FRESH coordinator port with exponential
     backoff, workers resuming from their latest valid checkpoint
     (docs/FAULT_TOLERANCE.md).  Returns 0, or the last failure's exit code
-    after printing the per-rank exit history."""
-    attempt = 0
-    history = []  # (attempt, [per-rank exit codes])
+    after printing the per-rank exit history.
+
+    Elastic mode (``elastic=True``, docs/FAULT_TOLERANCE.md §Elastic
+    resize): ``num_workers`` becomes the TARGET world size and exhausting
+    the restart budget no longer fails the job — the supervisor **shrinks**
+    instead, re-rendezvousing the surviving ranks on a fresh port with a
+    reduced ``MX_NUM_PROCS`` (one rank dropped per culprit of the last
+    attempt, floor ``min_workers``) and a fresh restart budget.  The old
+    world size is exported as ``MX_PREV_NUM_PROCS`` so workers know to
+    rebuild their mesh/kvstore/step and reshard their checkpoint on
+    restore.  ``initial_workers`` starts the gang below target (a fleet
+    that came up degraded), and ``regrow_after > 0`` re-admits rank slots:
+    after that many seconds of HEALTHY running below target the gang is
+    deliberately preempted (SIGTERM → final checkpoints) and re-spawned at
+    the full target — a returned host joining back.  A re-admitted rank
+    that keeps dying simply shrinks the gang again (probation loop).
+    Only when the budget is exhausted AT ``min_workers`` does the job
+    fail."""
+    incarnation = 0      # cumulative MX_RESTART_COUNT across resizes
+    attempt = 0          # restart budget used at the CURRENT world size
+    target = num_workers
+    world = min(target, max(1, int(initial_workers or target)))
+    # a degraded FIRST incarnation is not a resize: nothing to export
+    prev_world = None
+    history = []  # (incarnation, world, [per-rank exit codes])
     monitor = _HeartbeatMonitor(num_workers, env_extra)
     while True:
         port = _free_port()
+        monitor.num = world
         monitor.gang_started()
-        procs, tees = _spawn_gang(num_workers, command, env_extra, force_cpu,
-                                  port, attempt)
+        spawn_env = dict(env_extra or {})
+        if elastic:
+            spawn_env["MX_ELASTIC"] = "1"
+            if prev_world is not None and prev_world != world:
+                # workers record the telemetry `resize` event and reshard
+                # their restored checkpoints off this export
+                spawn_env["MX_PREV_NUM_PROCS"] = str(prev_world)
+        procs, tees = _spawn_gang(world, command, spawn_env, force_cpu,
+                                  port, incarnation)
+        # the resize export marks the FIRST incarnation after a resize
+        # only — a later same-size restart is not a resize
+        prev_world = None
+        regrow = (regrow_after if (elastic and regrow_after > 0
+                                   and world < target) else None)
         try:
-            rc = _wait_gang(procs, term_timeout, monitor)
+            rc, planned = _wait_gang(procs, term_timeout, monitor,
+                                     regrow_after=regrow)
         except KeyboardInterrupt:
             _terminate_gang(procs, term_timeout)
             return 130
@@ -490,7 +575,18 @@ def launch_local(num_workers: int, command, env_extra=None,
         # supervisor's own diagnosis/history output
         for t in tees:
             t.join(timeout=5.0)
-        history.append((attempt, [p.returncode for p in procs]))
+        history.append((incarnation, world, [p.returncode for p in procs]))
+        if planned:
+            # regrow: the gang was healthy below target long enough —
+            # preemption checkpoints are on disk, re-admit the missing
+            # rank slots at the full target world size
+            prev_world, world = world, target
+            incarnation += 1
+            attempt = 0
+            print(f"launch.py: growing gang {prev_world} -> {world} ranks "
+                  f"(stable for {regrow_after:.1f}s below target); "
+                  "re-rendezvous on a fresh port", file=sys.stderr)
+            continue
         if rc == 0:
             # every rank is reaped: the trace files are complete, so the
             # authoritative gang-wide merge happens HERE (rank 0's atexit
@@ -499,17 +595,31 @@ def launch_local(num_workers: int, command, env_extra=None,
             return 0
         monitor.diagnose()
         if attempt >= max_restarts:
+            if elastic and world > min_workers:
+                codes = [p.returncode for p in procs]
+                new_world = max(min_workers, world - _culprit_count(codes))
+                prev_world, world = world, new_world
+                incarnation += 1
+                attempt = 0
+                print(f"launch.py: restart budget exhausted at world size "
+                      f"{prev_world}; shrinking gang {prev_world} -> "
+                      f"{world} ranks (elastic resize), fresh restart "
+                      f"budget, re-rendezvous in {backoff:.1f}s",
+                      file=sys.stderr)
+                time.sleep(backoff)
+                continue
             _reexport_trace(monitor.dir)
-            if max_restarts > 0:
-                print(f"launch.py: giving up after {attempt + 1} attempts; "
+            if max_restarts > 0 or elastic:
+                print(f"launch.py: giving up after {len(history)} attempts; "
                       "per-rank exit history:", file=sys.stderr)
-                for a, codes in history:
-                    print("  attempt %d: %s" % (a, " ".join(
+                for inc, w, codes in history:
+                    print("  attempt %d (world %d): %s" % (inc, w, " ".join(
                         f"rank{i}={c}" + (
                             "(preempted)" if c == EXIT_PREEMPTED else "")
                         for i, c in enumerate(codes))), file=sys.stderr)
             return rc
         attempt += 1
+        incarnation += 1
         delay = backoff * (2 ** (attempt - 1))
         cause = ("worker preempted" if rc == EXIT_PREEMPTED
                  else "worker died")
@@ -543,6 +653,26 @@ def main(argv=None) -> int:
     ap.add_argument("--restart-backoff", type=float, default=1.0,
                     metavar="S", help="base of the exponential restart "
                                       "backoff (S, 2S, 4S, ...)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic gang resize: when the restart budget is "
+                         "exhausted, SHRINK the gang to the surviving "
+                         "ranks (reduced MX_NUM_PROCS, MX_PREV_NUM_PROCS "
+                         "exported, fresh budget) instead of failing; "
+                         "workers reshard their checkpoints on restore "
+                         "(docs/FAULT_TOLERANCE.md §Elastic resize)")
+    ap.add_argument("--min-workers", type=int, default=1, metavar="M",
+                    help="elastic shrink floor: the job only fails once "
+                         "the budget is exhausted at M ranks (default 1)")
+    ap.add_argument("--initial-workers", type=int, default=None,
+                    metavar="M", help="elastic: start the gang at M < N "
+                                      "ranks (a fleet that came up "
+                                      "degraded); pairs with "
+                                      "--regrow-after to grow toward -n")
+    ap.add_argument("--regrow-after", type=float, default=0.0, metavar="S",
+                    help="elastic: after S seconds of healthy running "
+                         "below the -n target, preempt the gang (final "
+                         "checkpoints) and re-spawn at the full target — "
+                         "the grow half of the resize (default 0 = never)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every worker")
     args = ap.parse_args(argv)
@@ -558,10 +688,22 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.max_restarts < 0:
         ap.error("--max-restarts must be >= 0")
+    if args.min_workers < 1 or args.min_workers > args.num_workers:
+        ap.error("--min-workers must be in [1, num-workers]")
+    if args.initial_workers is not None and not (
+            args.min_workers <= args.initial_workers <= args.num_workers):
+        ap.error("--initial-workers must be in [min-workers, num-workers]")
+    if (args.initial_workers is not None or args.regrow_after > 0) \
+            and not args.elastic:
+        ap.error("--initial-workers/--regrow-after require --elastic")
     return launch_local(args.num_workers, command, force_cpu=args.force_cpu,
                         max_restarts=args.max_restarts,
                         term_timeout=args.term_timeout,
-                        backoff=args.restart_backoff)
+                        backoff=args.restart_backoff,
+                        elastic=args.elastic,
+                        min_workers=args.min_workers,
+                        initial_workers=args.initial_workers,
+                        regrow_after=args.regrow_after)
 
 
 if __name__ == "__main__":

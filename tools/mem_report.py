@@ -137,7 +137,18 @@ def _rank_mem(events: List[dict], window: int) -> dict:
         for cat, nb in _cat_bytes(e).items():
             peak_cats[cat] = max(peak_cats.get(cat, 0), nb)
     last = mems[-1] if mems else {}
-    verdict = _leak_verdict(mems, window)
+    # an elastic resize restarts the process: a verdict window spanning
+    # the boundary mixes two allocator lifetimes, and the fresh
+    # incarnation's normal ramp-up (params placed, caches warming) reads
+    # as monotonic "leak" growth.  The trend rule runs on the newest
+    # segment only; watermark/peaks above stay whole-stream.
+    resize_stamps = [float(e["t"]) for e in events
+                     if e.get("kind") == "resize" and "t" in e]
+    trend_mems = mems
+    if resize_stamps:
+        cut = max(resize_stamps)
+        trend_mems = [e for e in mems if float(e.get("t", cut)) >= cut]
+    verdict = _leak_verdict(trend_mems, window)
     if leaks and verdict["verdict"] != "leak":
         # the live detector fired mid-run even if the trailing window
         # has since flattened (e.g. the leak crashed the run) — a

@@ -148,7 +148,29 @@ def _pair_spans(events: List[dict]) -> List[dict]:
 # ---------------------------------------------------------------------------
 # analysis
 # ---------------------------------------------------------------------------
+def _resize_stamps(events: List[dict]) -> List[float]:
+    """Wall stamps of ``resize`` events (elastic gang resize — a new
+    incarnation at a different world size, recorded by
+    ``parallel/dist.py`` at the post-resize rendezvous)."""
+    return sorted(float(e["t"]) for e in events
+                  if e.get("kind") == "resize" and "t" in e)
+
+
 def _rank_stats(events: List[dict], window: int) -> dict:
+    # an elastic resize restarts the process, re-rendezvouses and
+    # RECOMPILES every executable: the teardown silence and the fresh
+    # compile wall belong to the resize, not to this rank's behavior.
+    # Skew/idle accounting therefore runs on the NEWEST segment only
+    # (events after the last resize) — without this, every survivor of a
+    # resize reads as an idle-gap straggler against a rank that died
+    # before it.
+    resizes = _resize_stamps(events)
+    n_resizes = len(resizes)
+    if resizes:
+        cut = resizes[-1]
+        events = [e for e in events
+                  if e.get("kind") == "resize"
+                  or float(e.get("t", cut)) >= cut]
     steps = [e for e in events if e.get("kind") == "step"]
     steady = [e for e in steps if not e.get("traced")]
     compile_ = [e for e in steps if e.get("traced")]
@@ -196,6 +218,7 @@ def _rank_stats(events: List[dict], window: int) -> dict:
         agg["total_ms"] += s["dur_ms"]
         agg["max_ms"] = max(agg["max_ms"], s["dur_ms"])
     return {
+        "resizes": n_resizes,
         "steps": len(steps),
         "steady_steps": len(steady),
         "compile_steps": len(compile_),
@@ -265,13 +288,18 @@ def _retrace_table(ranks: Dict[int, List[dict]]) -> List[dict]:
 
 
 def _event_gaps(ranks: Dict[int, List[dict]], gap_sec: float) -> List[dict]:
-    """Stretches of stream silence longer than gap_sec, per rank."""
+    """Stretches of stream silence longer than gap_sec, per rank.  A gap
+    containing a ``resize`` stamp is the gang teardown + re-rendezvous of
+    an elastic resize — planned dead time, not a hung rank."""
     rows = []
     for rank, events in sorted(ranks.items()):
+        resizes = _resize_stamps(events)
         stamps = sorted(float(e["t"]) for e in events
                         if "t" in e and e.get("kind") != "clock_anchor")
         for prev, cur in zip(stamps, stamps[1:]):
             if cur - prev > gap_sec:
+                if any(prev < s <= cur for s in resizes):
+                    continue
                 rows.append({"rank": rank, "at": round(prev, 3),
                              "gap_sec": round(cur - prev, 3)})
     return rows
@@ -359,6 +387,14 @@ def build_report(directory: str, window: Optional[int] = None,
     stragglers = _find_stragglers(per_rank, pct)
     retraces = _retrace_table(ranks)
     gaps = _event_gaps(ranks, gap_sec)
+    resizes = []
+    for r, events in sorted(ranks.items()):
+        for e in events:
+            if e.get("kind") == "resize":
+                resizes.append({"rank": r,
+                                "old_world": e.get("old_world"),
+                                "new_world": e.get("new_world"),
+                                "at": round(float(e.get("t", 0.0)), 3)})
     anomalies = []
     for s in stragglers:
         anomalies.append(f"straggler: rank {s['rank']} ({s['rule']}): "
@@ -386,6 +422,7 @@ def build_report(directory: str, window: Optional[int] = None,
                                       for s in per_rank.values()), 3),
         "collectives": _collective_table(ranks),
         "retraces": retraces,
+        "resizes": resizes,
         "event_gaps": gaps,
         "stragglers": stragglers,
         "warnings": warnings,
@@ -420,6 +457,12 @@ def format_text(rep: dict) -> str:
         w(f"  {name:<12} mean {ph['mean_ms']:8.3f}ms   "
           f"total {ph['total_ms']:10.1f}ms   n={ph['count']}")
     w("")
+    for row in rep.get("resizes", []):
+        w(f"  elastic resize: rank {row['rank']} rejoined at world size "
+          f"{row['new_world']} (was {row['old_world']}) — skew/idle stats "
+          "below cover the post-resize segment only")
+    if rep.get("resizes"):
+        w("")
     w("per-rank skew")
     w(f"  {'rank':>4} {'steps':>6} {'win mean ms':>12} {'block ms':>10} "
       f"{'idle gap ms':>12} {'h2d':>10} straggler")
