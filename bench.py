@@ -425,6 +425,24 @@ def main():
             result["memwatch_overhead"] = movh
             print(json.dumps(result), flush=True)
 
+    # metrics_scrape_overhead: steps/sec with the live /metrics endpoint
+    # up and a 1 Hz scraper hammering it (telemetry on in BOTH modes, so
+    # the number isolates the endpoint + scraper) vs the endpoint off —
+    # the "scraping a rank must not perturb training" claim
+    # (docs/OBSERVABILITY.md §Live metrics) measured like
+    # telemetry_overhead (interleaved interquartile-mean chunks).
+    # Acceptance <2% (value >= 0.98); BENCH_METRICS=0 skips.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_METRICS", "1") != "0"
+            and "error" not in result):
+        sovh = _run_child("cpu", float(os.environ.get(
+            "BENCH_METRICS_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "metrics_scrape_overhead"})
+        if sovh is not None:
+            sovh.pop("probe_history", None)
+            result["metrics_scrape_overhead"] = sovh
+            print(json.dumps(result), flush=True)
+
 
 # ---------------------------------------------------------------------------
 # measurement children
@@ -1086,6 +1104,112 @@ def bench_memwatch_overhead(platform):
     }))
 
 
+def bench_metrics_scrape_overhead(platform):
+    """Secondary metric: steady-state steps/sec with the live metrics
+    endpoint serving AND a 1 Hz scraper hammering ``/metrics`` vs the
+    endpoint fully off, telemetry enabled in BOTH modes (the delta is
+    the endpoint + scrape load alone — /metrics renders from the
+    recorder's locked rollups, so the claim under test is that a scrape
+    never perturbs the dispatch loop).  Acceptance bar is <2% overhead
+    (value >= 0.98) — same interleaved interquartile-mean estimator as
+    telemetry_overhead (this box drifts 2x at sub-second scale)."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import gluon, metrics_server, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    B = int(os.environ.get("BENCH_METRICS_BATCH", 256))
+    D = int(os.environ.get("BENCH_METRICS_DIM", 8192))
+    steps = int(os.environ.get("BENCH_METRICS_STEPS", 8))
+    trials = int(os.environ.get("BENCH_METRICS_TRIALS", 24))
+
+    rng = np.random.RandomState(0)
+    from mxnet_tpu import nd
+
+    x = nd.array(rng.rand(B, D).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, B).astype(np.float32))
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    step = DataParallelStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mesh=local_mesh(devices=[ctx.jax_device]), optimizer="sgd",
+        optimizer_params={"learning_rate": 1e-3})
+
+    import tempfile
+
+    tele_dir = tempfile.mkdtemp(prefix="bench_metrics_")
+    telemetry.enable(tele_dir)
+    scrapes = [0]
+    scrape_errs = []  # a dead/never-scraping scraper must fail the run
+    #                   loudly, not report a vacuous ~1.0 overhead
+
+    def one_trial(scrape_on):
+        stop = th = None
+        if scrape_on:
+            assert metrics_server.start(0), "metrics endpoint failed to bind"
+            url = f"http://127.0.0.1:{metrics_server.port()}/metrics"
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        body = urllib.request.urlopen(url, timeout=2).read()
+                        if not body.endswith(b"# EOF\n"):
+                            scrape_errs.append(f"torn scrape: {body[-50:]!r}")
+                            return
+                        scrapes[0] += 1
+                    except OSError as e:
+                        scrape_errs.append(str(e))
+                    stop.wait(1.0)  # the 1 Hz production scrape cadence
+
+            th = threading.Thread(target=hammer, daemon=True)
+            th.start()
+        t0 = time.perf_counter()
+        loss = None
+        for _i in range(steps):
+            loss = step.step(x, y)
+        step.drain()
+        float(loss)
+        dt = time.perf_counter() - t0
+        if scrape_on:
+            stop.set()
+            th.join(timeout=5.0)
+            metrics_server.stop()  # endpoint truly OFF in the off chunks
+        return dt
+
+    one_trial(False)
+    one_trial(True)  # warm the compile cache + the HTTP stack
+    offs, ons = [], []
+    for _ in range(trials):
+        offs.append(one_trial(False))
+        ons.append(one_trial(True))
+    assert scrapes[0] > 0, \
+        f"scraper never completed a scrape — metric is vacuous: {scrape_errs}"
+    assert not any("torn" in e for e in scrape_errs), scrape_errs
+
+    iq_off, iq_on = _iq_mean(offs), _iq_mean(ons)
+    print(json.dumps({
+        "metric": "metrics_scrape_overhead",
+        "value": round(iq_off / iq_on, 4),
+        "unit": "x_on_vs_off",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "on_steps_per_sec": round(steps / iq_on, 2),
+        "off_steps_per_sec": round(steps / iq_off, 2),
+        "scrapes": scrapes[0],
+        "batch": B, "dim": D, "steps": steps,
+    }))
+
+
 def bench_cold_start(platform):
     """cold_start child: ONE process's time-to-first-step on a toy net
     sized so XLA compile dominates (the regime the AOT executable cache
@@ -1164,6 +1288,8 @@ def child_main(platform):
         bench_telemetry_overhead(platform)
     elif model == "memwatch_overhead":
         bench_memwatch_overhead(platform)
+    elif model == "metrics_scrape_overhead":
+        bench_metrics_scrape_overhead(platform)
     elif model == "cold_start":
         bench_cold_start(platform)
     else:

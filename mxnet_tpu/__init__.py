@@ -53,6 +53,7 @@ from .monitor import Monitor
 from . import profiler
 from . import telemetry
 from . import memwatch
+from . import metrics_server
 from . import runtime
 from . import util
 from .util import is_np_array
@@ -76,6 +77,12 @@ parallel.dist.init_from_env()
 # surface set-but-ineffective MXNET_* env vars in logs (env_vars.describe()
 # has the full disposition table)
 env_vars.check()
+
+# live metrics endpoint (docs/OBSERVABILITY.md §Live metrics): serves
+# /metrics /healthz /statusz when MX_METRICS_PORT enables it — after the
+# rendezvous above so telemetry.rank() (the port offset + portfile name)
+# reflects this process's gang rank
+metrics_server.maybe_start()
 
 
 def waitall():

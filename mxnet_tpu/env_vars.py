@@ -190,6 +190,30 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "(TPU), 1 forces it (interpret-mode tests), 0 pins the XLA "
         "gather path — the bitwise-parity path "
         "(serving/engine.py _serve_fused)"),
+    # serving SLO counters (docs/SERVING.md §SLO telemetry; visible live
+    # via the metrics endpoint and in the launch.py gang merge)
+    "MX_SERVE_SLO_TTFT_MS": (
+        "honored", "submission->first-token SLO in ms (queue wait "
+        "INCLUDED — the user-visible TTFT; 0/unset = no SLO): a "
+        "completed request whose TTFT exceeds it bumps "
+        "mx_serve_slo_violations_total{stage=\"ttft\"} and records a "
+        "serve_slo_violation event (telemetry.record_serve_request)"),
+    "MX_SERVE_SLO_TPOT_MS": (
+        "honored", "time-per-output-token SLO in ms (decode wall / "
+        "tokens; 0/unset = no SLO): violations bump "
+        "mx_serve_slo_violations_total{stage=\"tpot\"} "
+        "(telemetry.record_serve_request)"),
+    # live metrics endpoint (docs/OBSERVABILITY.md §Live metrics)
+    "MX_METRICS_PORT": (
+        "honored", "per-rank HTTP /metrics /healthz /statusz endpoint "
+        "(metrics_server.py): unset/off = disabled (default); 0/auto = "
+        "ephemeral port advertised via metrics-port-<R>.json next to "
+        "the heartbeat (tools/launch.py --metrics-port discovers it for "
+        "the merged gang /metrics); N>0 = bind N+rank"),
+    "MX_METRICS_HOST": (
+        "honored", "bind address of the live metrics endpoint (default "
+        "127.0.0.1; set 0.0.0.0 to expose it to a cross-host scraper) "
+        "(metrics_server.py)"),
     # runtime telemetry (docs/OBSERVABILITY.md)
     "MX_TELEMETRY_DIR": (
         "honored", "enables the telemetry recorder: one rank-<R>.jsonl "

@@ -439,9 +439,32 @@ class ServingEngine:
                     continue
                 break
             burst = self._ensure_pages(self._stream_every)
+            # request ids decoding THIS burst, captured before _consume
+            # can evict finished ones
+            burst_ids = [m.req.id for m in self._slots
+                         if m is not None and not m.done]
+            t_burst0 = time.perf_counter()
             handles = [self._dispatch_step() for _ in range(burst)]
             self._book_pending_compile()
+            t_stream0 = time.perf_counter()
             self._consume(handles)
+            t_stream1 = time.perf_counter()
+            # per-request trace spans at BURST cadence, never per token
+            # (docs/OBSERVABILITY.md §Serving traces): one serve_decode
+            # span per in-flight request covering dispatch through token
+            # readback, plus one serve_stream span for the readback
+            # boundary carrying the occupancy gauges trace_report turns
+            # into the slot-occupancy timeline.  record_span is the
+            # zero-cost-when-off retroactive form — the dispatch loop
+            # above never pays for tracing.
+            if telemetry.spans_enabled():
+                for rid in burst_ids:
+                    telemetry.record_span("serve_decode", t_burst0,
+                                          t_stream1, request_id=rid,
+                                          steps=burst)
+                telemetry.record_span("serve_stream", t_stream0, t_stream1,
+                                      active_slots=len(burst_ids),
+                                      queue_depth=self._sched.depth)
             telemetry.record_serve_state(queue_depth=self._sched.depth,
                                          active_slots=active)
             guard += burst
@@ -649,6 +672,14 @@ class ServingEngine:
 
     def _admit(self, slot: int, req: Request):
         st = self._state
+        # the queue leg of the request-id span tree: queue-start ->
+        # admit, recorded retroactively from the scheduler's SLO stamps
+        # (t_queue_start, not t_submit: a preempted request's re-queue
+        # span must not swallow its first admission's prefill+decode)
+        if req.t_queue_start is not None and req.t_admit is not None \
+                and telemetry.spans_enabled():
+            telemetry.record_span("serve_queue", req.t_queue_start,
+                                  req.t_admit, request_id=req.id)
         src = self._adapter.prefill_src(req)
         if src is not None:
             self._ensure_prefill(src)
@@ -656,9 +687,13 @@ class ServingEngine:
 
             t0 = time.perf_counter()
             outs = self._prefill_run(self._params(), jnp.asarray(src))
+            t1 = time.perf_counter()
             # prefill_ms is DISPATCH wall (async queueing, like step
             # events — see telemetry.record_step's contract)
-            req.prefill_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            req.prefill_ms = round((t1 - t0) * 1e3, 3)
+            if telemetry.spans_enabled():
+                telemetry.record_span("serve_prefill", t0, t1,
+                                      request_id=req.id)
             if "serving_prefill" in self._pending_compile:
                 self._pending_compile["serving_prefill"].setdefault(
                     "wall_s", time.perf_counter() - t0)
@@ -737,6 +772,8 @@ class ServingEngine:
         req = meta.req
         req.stream.tokens.clear()
         req.t_admit = None
+        req.t_first_token = None  # TTFT re-stamps after re-admission,
+        #                           still measured from the ORIGINAL submit
         req.prefill_ms = 0.0
         telemetry.record("serve_preempt", request_id=req.id,
                          decoded=meta.pos)
@@ -758,6 +795,11 @@ class ServingEngine:
                 req = meta.req
                 tok = int(toks[slot])
                 req.stream.append(tok)
+                if req.t_first_token is None:
+                    # stream-boundary resolution: the whole burst's tokens
+                    # land together, so TTFT is stamped when the FIRST
+                    # one becomes host-visible — the user-visible moment
+                    req.t_first_token = time.perf_counter()
                 if tok == req.eos_id:
                     meta.done = True
                     req.stream.finish("eos")
@@ -777,10 +819,18 @@ class ServingEngine:
         for name in self._extra_names:
             st[name][slot] = 0
         req = meta.req
-        decode_ms = max(0.0, (time.perf_counter() - req.t_admit) * 1e3
+        now = time.perf_counter()
+        decode_ms = max(0.0, (now - req.t_admit) * 1e3
                         - req.prefill_ms) if req.t_admit else 0.0
+        # total_ms is the TRUE submit->finish wall: for a preempted
+        # request the per-leg fields cover only the last admission, but
+        # the SLO latency must include the discarded service period
+        total_ms = ((now - req.t_submit) * 1e3
+                    if req.t_submit is not None else None)
         telemetry.record_serve_request(
             queue_wait_ms=req.queue_wait_ms, prefill_ms=req.prefill_ms,
             decode_ms=round(decode_ms, 3), tokens=len(req.stream),
+            ttft_ms=round(req.ttft_ms, 3),
+            total_ms=round(total_ms, 3) if total_ms is not None else None,
             request_id=req.id, reason=req.stream.finish_reason)
         self._slots[slot] = None

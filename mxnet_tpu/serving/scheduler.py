@@ -86,16 +86,36 @@ class Request:
         self.id = request_id if request_id is not None \
             else f"req{next(_ids)}"
         self.stream = TokenStream()
-        # SLO telemetry stamps (perf_counter; wall deltas only)
+        # SLO telemetry stamps (perf_counter; wall deltas only).
+        # t_first_token is stamped at the STREAM BOUNDARY that read the
+        # first token back (burst-cadence resolution — the engine never
+        # blocks per token), measured against t_submit: the user-visible
+        # SUBMISSION-to-first-token TTFT (queue wait included), across
+        # preemptions.
+        # t_queue_start is the start of the CURRENT queue residence —
+        # submit time, re-stamped by requeue() after a preemption — so
+        # the serve_queue trace span covers only the latest queue leg,
+        # never the first admission's prefill+decode.
         self.t_submit: Optional[float] = None
+        self.t_queue_start: Optional[float] = None
         self.t_admit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
         self.prefill_ms: float = 0.0
+        # accumulated TRUE queue residence across admissions: each
+        # pop_ready adds its leg (t_queue_start -> t_admit), so a
+        # preempted request's first service period never counts as
+        # "queue wait" — the serve_queue spans and this number agree
+        self.queue_ms_acc: float = 0.0
+
+    @property
+    def ttft_ms(self) -> float:
+        if self.t_submit is None or self.t_first_token is None:
+            return 0.0
+        return (self.t_first_token - self.t_submit) * 1e3
 
     @property
     def queue_wait_ms(self) -> float:
-        if self.t_submit is None or self.t_admit is None:
-            return 0.0
-        return (self.t_admit - self.t_submit) * 1e3
+        return self.queue_ms_acc
 
     def __repr__(self):
         return (f"<Request {self.id} prompt={len(self.tokens)} "
@@ -128,6 +148,7 @@ class ContinuousBatchingScheduler:
                 f"serving queue full ({len(self._q)}/{bound} waiting): "
                 "raise MX_SERVE_QUEUE or shed load upstream")
         request.t_submit = time.perf_counter()
+        request.t_queue_start = request.t_submit
         self._q.append(request)
         return request
 
@@ -136,6 +157,7 @@ class ContinuousBatchingScheduler:
         pressure evicted it mid-decode; it must not lose its place or be
         dropped by the bound — preemption is the engine's problem, not
         the client's)."""
+        request.t_queue_start = time.perf_counter()
         self._q.appendleft(request)
 
     def pop_ready(self, free_slots: int, pages_free: int,
@@ -149,6 +171,8 @@ class ContinuousBatchingScheduler:
         while self._q and len(out) < free_slots and budget >= 1:
             req = self._q.popleft()
             req.t_admit = time.perf_counter()
+            if req.t_queue_start is not None:
+                req.queue_ms_acc += (req.t_admit - req.t_queue_start) * 1e3
             out.append(req)
             budget -= 1  # reserve the first page; later pages grow on
             #              demand per dispatch burst (engine._ensure_pages)

@@ -61,9 +61,13 @@ despite rank start-time skew.  Spans are on whenever the recorder is on;
 ``MX_TELEMETRY_SPANS=0`` is the kill switch.  ``export_chrome_trace(dir)``
 merges every rank's stream into a Chrome/Perfetto trace-event JSON (one
 track per rank, spans nested, collectives as flow events);
-``export_prometheus(path)`` writes an OpenMetrics text snapshot of the
-``summary()`` rollups for production scraping.  ``MX_TRACE_EXPORT``
-(default off) runs both automatically at process exit.
+``render_prometheus(mode)`` renders an OpenMetrics exposition of the
+``summary()`` rollups — ONE formatter behind two sinks:
+``export_prometheus(path)`` (file snapshot, ``mode="atexit"``) and the
+live per-rank HTTP endpoint in ``mxnet_tpu.metrics_server``
+(``MX_METRICS_PORT``; ``mode="live"`` — docs/OBSERVABILITY.md §Live
+metrics).  ``MX_TRACE_EXPORT`` (default off) runs the file exports
+automatically at process exit.
 """
 from __future__ import annotations
 
@@ -84,7 +88,8 @@ __all__ = ["enabled", "enable", "disable", "record", "record_step",
            "heartbeat", "note_signature", "summary", "flight_tail", "flush",
            "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE",
            "span", "record_span", "spans_enabled", "export_chrome_trace",
-           "export_prometheus"]
+           "export_prometheus", "render_prometheus", "health_snapshot",
+           "stale_after_sec"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -162,7 +167,12 @@ class _State:
         self.serve = {"requests": 0, "tokens": 0, "queue_wait_ms": 0.0,
                       "prefill_ms": 0.0, "decode_ms": 0.0,
                       "lat_ms": deque(maxlen=512),
+                      "ttft_ms": deque(maxlen=512),
+                      "slo_ttft": 0, "slo_tpot": 0,
                       "queue_depth": 0, "active_slots": 0}
+        # newest in-flight dispatch-window depth any executor reported
+        # (record_step's inflight_depth field) — a /healthz input
+        self.inflight_depth = 0
         self.ckpt = {"saves": 0, "save_ms": 0.0, "save_bytes": 0,
                      "loads": 0, "load_ms": 0.0, "fallbacks": 0}
         # executor -> {"sigs": set, "traces": int, "warned_at": int,
@@ -502,6 +512,8 @@ def record_step(executor: str, step: int, wall_s: float,
                 st["samples"] += int(samples)
         st["bytes"] += int(transfer_bytes)
         st["overlap_bytes"] += int(h2d_overlapped)
+        if "inflight_depth" in fields:
+            _state.inflight_depth = int(fields["inflight_depth"])
     ev = dict(executor=executor, step=int(step), wall_ms=round(wall_ms, 3),
               traced=bool(traced), **fields)
     if samples is not None:
@@ -574,20 +586,49 @@ def record_fused_update(n_params: int, n_buckets: int, nbytes: int,
            nbytes=int(nbytes), n_jitted_calls=int(n_jitted_calls), **fields)
 
 
+def _slo_ms(name: str) -> float:
+    """A latency SLO threshold in ms; 0/unset/garbage = no SLO."""
+    return max(0.0, _env_float(name, 0.0))
+
+
 def record_serve_request(queue_wait_ms: float = 0.0,
                          prefill_ms: float = 0.0, decode_ms: float = 0.0,
-                         tokens: int = 0, **fields) -> None:
+                         tokens: int = 0, ttft_ms: float = 0.0,
+                         total_ms: Optional[float] = None,
+                         **fields) -> None:
     """One COMPLETED serving request (mxnet_tpu.serving.engine): how
-    long it queued, the prefill dispatch wall, the decode wall, and how
-    many tokens it produced.  End-to-end latency (the SLO number) is the
-    sum; a bounded reservoir of the newest 512 latencies backs the
-    rolling p50/p99 in ``summary()['serving']`` and the ``mx_serve_*``
-    gauges in :func:`export_prometheus`.  Per-request events land in the
-    flight ring, so a gang post-mortem tail shows the last served
-    requests."""
+    long it queued, the prefill dispatch wall, the decode wall, how
+    many tokens it produced, and the submission->first-token wall
+    (``ttft_ms``, queue wait included — the user-visible TTFT, stamped
+    at stream-boundary resolution).  End-to-end
+    latency (the SLO number) is ``total_ms`` when the caller measured
+    the true submit->finish wall (the serving engine does — a PREEMPTED
+    request's discarded first service period must count toward its
+    latency even though its per-leg fields cover only the last
+    admission), else the sum of the three legs; bounded reservoirs of
+    the newest 512 latencies/TTFTs back the rolling p50/p99 in
+    ``summary()['serving']`` and the ``mx_serve_*`` gauges in
+    :func:`render_prometheus`.  Per-request events land in the flight
+    ring, so a gang post-mortem tail shows the last served requests.
+
+    SLO accounting (docs/SERVING.md §SLO telemetry): with
+    ``MX_SERVE_SLO_TTFT_MS`` / ``MX_SERVE_SLO_TPOT_MS`` set (>0), a
+    request whose TTFT exceeds the former or whose time-per-output-token
+    (decode wall / tokens) exceeds the latter bumps
+    ``mx_serve_slo_violations_total{stage=...}`` and leaves a
+    ``serve_slo_violation`` event naming the request."""
     if not _state.enabled:
         return
-    latency = float(queue_wait_ms) + float(prefill_ms) + float(decode_ms)
+    latency = (float(total_ms) if total_ms is not None else
+               float(queue_wait_ms) + float(prefill_ms) + float(decode_ms))
+    slo_ttft = _slo_ms("MX_SERVE_SLO_TTFT_MS")
+    slo_tpot = _slo_ms("MX_SERVE_SLO_TPOT_MS")
+    tpot_ms = float(decode_ms) / tokens if tokens else 0.0
+    violations = []
+    if slo_ttft and float(ttft_ms) > slo_ttft:
+        violations.append(("ttft", round(float(ttft_ms), 3), slo_ttft))
+    if slo_tpot and tpot_ms > slo_tpot:
+        violations.append(("tpot", round(tpot_ms, 3), slo_tpot))
     with _state.lock:
         sv = _state.serve
         sv["requests"] += 1
@@ -596,9 +637,18 @@ def record_serve_request(queue_wait_ms: float = 0.0,
         sv["prefill_ms"] += float(prefill_ms)
         sv["decode_ms"] += float(decode_ms)
         sv["lat_ms"].append(latency)
+        if ttft_ms:
+            sv["ttft_ms"].append(float(ttft_ms))
+        for stage, _v, _t in violations:
+            sv[f"slo_{stage}"] += 1
     record("serve_request", queue_wait_ms=round(queue_wait_ms, 3),
            prefill_ms=round(prefill_ms, 3), decode_ms=round(decode_ms, 3),
-           latency_ms=round(latency, 3), tokens=int(tokens), **fields)
+           latency_ms=round(latency, 3), tokens=int(tokens),
+           ttft_ms=round(float(ttft_ms), 3), **fields)
+    for stage, value_ms, threshold_ms in violations:
+        record("serve_slo_violation", stage=stage, value_ms=value_ms,
+               threshold_ms=threshold_ms,
+               request_id=fields.get("request_id"))
 
 
 def record_serve_state(queue_depth: int, active_slots: int) -> None:
@@ -776,6 +826,7 @@ def _serving_rollup() -> dict:
     """summary()['serving'] block (caller holds _state.lock)."""
     sv = _state.serve
     lat = sorted(sv["lat_ms"])
+    ttft = sorted(sv["ttft_ms"])
     return {
         "requests": sv["requests"],
         "tokens": sv["tokens"],
@@ -784,6 +835,9 @@ def _serving_rollup() -> dict:
         "decode_ms": round(sv["decode_ms"], 3),
         "p50_latency_ms": round(_percentile(lat, 50), 3),
         "p99_latency_ms": round(_percentile(lat, 99), 3),
+        "p50_ttft_ms": round(_percentile(ttft, 50), 3),
+        "p99_ttft_ms": round(_percentile(ttft, 99), 3),
+        "slo_violations": {"ttft": sv["slo_ttft"], "tpot": sv["slo_tpot"]},
         "queue_depth": sv["queue_depth"],
         "active_slots": sv["active_slots"],
     }
@@ -838,10 +892,62 @@ def summary() -> dict:
                 for name, agg in _state.spans.items()
             },
             "retraces": retraces,
+            "inflight_depth": _state.inflight_depth,
             "restart_count": int(
                 os.environ.get("MX_RESTART_COUNT", "0") or 0),
         }
     return out
+
+
+# ---------------------------------------------------------------------------
+# health (metrics_server /healthz; the same staleness rule the
+# tools/launch.py supervisor applies to heartbeat FILES)
+# ---------------------------------------------------------------------------
+def stale_after_sec() -> float:
+    """Seconds without a heartbeat before this rank counts as stale:
+    several missed beats, floored so sub-second test configs don't flag
+    healthy processes on a loaded host (mirrored in tools/launch.py
+    _HeartbeatMonitor — keep in sync)."""
+    return max(2.0, 5.0 * max(0.0, _env_float("MX_HEARTBEAT_SEC", 5.0)))
+
+
+def health_snapshot() -> dict:
+    """Liveness verdict from the recorder's locked rollups only (no jax,
+    no device sync — the /healthz contract): heartbeat age vs the
+    supervisor's staleness rule, the last heartbeat step, the gang
+    restart count, and the in-flight dispatch depth.  ``healthy`` is
+    False only when heartbeats were flowing and then stopped; a process
+    that never heartbeat (telemetry off, or startup) reports
+    ``heartbeat_age_s: None`` and stays healthy — liveness of the HTTP
+    thread itself is then the only claim being made."""
+    stale_after = stale_after_sec()
+    with _state.lock:
+        hb_wall = _state.hb_wall
+        hb_step = _state.hb_step
+        inflight = _state.inflight_depth
+        sv_depth = _state.serve["queue_depth"]
+        sv_slots = _state.serve["active_slots"]
+        on = _state.enabled
+    age = max(0.0, time.time() - hb_wall) if hb_wall else None
+    reasons = []
+    if age is not None and age > stale_after:
+        reasons.append(f"last heartbeat {age:.1f}s ago "
+                       f"(stale after {stale_after:.1f}s)")
+    return {
+        "healthy": not reasons,
+        "reasons": reasons,
+        "telemetry_enabled": on,
+        "rank": _state.rank if on else rank(),
+        "heartbeat_age_s": round(age, 3) if age is not None else None,
+        "stale_after_s": round(stale_after, 3),
+        "last_step": hb_step if hb_step >= 0 else None,
+        "restart_count": int(os.environ.get("MX_RESTART_COUNT", "0") or 0),
+        "inflight_depth": inflight,
+        "serve_queue_depth": sv_depth,
+        "serve_active_slots": sv_slots,
+        "pid": os.getpid(),
+        "time": round(time.time(), 3),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1062,17 +1168,17 @@ def _prom_escape(value: str) -> str:
     return str(value).replace("\\", r"\\").replace('"', r'\"')
 
 
-def export_prometheus(path: Optional[str] = None) -> Optional[str]:
-    """Write an OpenMetrics text snapshot of this process's ``summary()``
-    rollups to ``path`` (default ``<telemetry dir>/metrics-<rank>.prom``)
-    and return the path — the production-scrape surface: point a node
-    exporter textfile collector (or any OpenMetrics scraper) at it to get
-    step rate, block-wait, collective bytes, retrace count, and heartbeat
-    age without touching the JSONL streams."""
-    if path is None:
-        if not _state.dir:
-            return None
-        path = os.path.join(_state.dir, f"metrics-{_state.rank}.prom")
+def render_prometheus(mode: str = "live") -> str:
+    """Render this process's ``summary()`` + memwatch rollups as ONE
+    OpenMetrics text exposition ending in ``# EOF`` — the single
+    formatter shared by BOTH sinks: :func:`export_prometheus` (file
+    snapshot, ``mode="atexit"``) and the live ``mxnet_tpu.metrics_server``
+    ``/metrics`` endpoint (``mode="live"``).  Every render stamps
+    ``mx_export_timestamp_seconds`` and ``mx_export_mode{mode=...}`` so a
+    dashboard can tell a dead rank's last atexit snapshot from a live
+    scrape.  Reads the recorder's locked rollups only: no jax, no device
+    sync, safe from any thread at any time (including concurrently with
+    a flush)."""
     s = summary()
     rank_lbl = f'rank="{s["rank"]}"'
     lines: List[str] = []
@@ -1090,6 +1196,13 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
                 f'{name}{{{rank_lbl},{label_key}="{_prom_escape(key)}"}} '
                 f"{v}")
 
+    # export provenance first: a scraper (or the launch.py gang merge)
+    # derives per-rank staleness from the timestamp, and the mode label
+    # says whether these numbers are a live process or a final snapshot
+    gauge("mx_export_timestamp_seconds", round(time.time(), 3))
+    lines.append("# TYPE mx_export_mode gauge")
+    lines.append(f'mx_export_mode{{{rank_lbl},'
+                 f'mode="{_prom_escape(mode)}"}} 1')
     steps = s["steps"]
     per_key("mx_step_total", steps, "count", "executor")
     per_key("mx_step_compile_total", steps, "compile_count", "executor")
@@ -1127,6 +1240,13 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
         gauge("mx_serve_decode_ms_total", sv["decode_ms"], kind="counter")
         gauge("mx_serve_latency_p50_ms", sv["p50_latency_ms"])
         gauge("mx_serve_latency_p99_ms", sv["p99_latency_ms"])
+        gauge("mx_serve_ttft_p50_ms", sv["p50_ttft_ms"])
+        gauge("mx_serve_ttft_p99_ms", sv["p99_ttft_ms"])
+        lines.append("# TYPE mx_serve_slo_violations_total counter")
+        for stage in ("ttft", "tpot"):
+            lines.append(
+                f'mx_serve_slo_violations_total{{{rank_lbl},'
+                f'stage="{stage}"}} {sv["slo_violations"][stage]}')
         gauge("mx_serve_queue_depth", sv["queue_depth"])
         gauge("mx_serve_active_slots", sv["active_slots"])
     per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
@@ -1165,13 +1285,29 @@ def export_prometheus(path: Optional[str] = None) -> Optional[str]:
                   kind="counter")
             gauge("mx_mem_compile_cache_hits_total",
                   ms["compiles"].get("cache_hits", 0), kind="counter")
-    except Exception:  # the snapshot must land even if memwatch breaks
+    except Exception:  # the exposition must land even if memwatch breaks
         pass
     lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def export_prometheus(path: Optional[str] = None) -> Optional[str]:
+    """Write an OpenMetrics text snapshot (one :func:`render_prometheus`
+    render, ``mode="atexit"``) to ``path`` (default ``<telemetry
+    dir>/metrics-<rank>.prom``) and return the path — the file-sink half
+    of the formatter: point a node exporter textfile collector at it.
+    For pull-based scraping of a LIVE process use
+    ``mxnet_tpu.metrics_server`` (MX_METRICS_PORT), which serves the
+    same exposition with ``mode="live"``."""
+    if path is None:
+        if not _state.dir:
+            return None
+        path = os.path.join(_state.dir, f"metrics-{_state.rank}.prom")
+    body = render_prometheus(mode="atexit")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write(body)
     os.replace(tmp, path)  # scrapers never see a torn snapshot
     return path
 

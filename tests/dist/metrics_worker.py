@@ -1,0 +1,60 @@
+"""Gang worker for the live-metrics acceptance test (docs/OBSERVABILITY.md
+§Live metrics): trains a tiny net while serving /metrics and /healthz
+live (MX_METRICS_PORT=0 exported by the launch.py --metrics-port
+supervisor -> ephemeral port + portfile), then idles until the test
+drops MX_STOP_FILE.  SIGTERM exits 0 immediately: the test "kills" rank
+1 this way so the supervisor keeps the gang (and its merged /metrics)
+alive while the test asserts the dead rank's ``up`` gauge flipped."""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, metrics_server, nd, telemetry
+
+
+def main():
+    assert telemetry.enabled(), "MX_TELEMETRY_DIR must be set"
+    assert metrics_server.enabled(), \
+        "MX_METRICS_PORT must have started the endpoint at import"
+    rank = telemetry.rank()
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+
+    mx.random.seed(rank)
+    rng = np.random.RandomState(rank)
+    X = rng.rand(8, 4).astype(np.float32)
+    Y = (X @ rng.rand(4, 1).astype(np.float32))
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Normal(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    for i in range(20):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(8)
+        telemetry.heartbeat(i + 1, force=True)
+    telemetry.flush()
+    print(f"worker {rank}: training done, port {metrics_server.port()}",
+          flush=True)
+
+    stop = os.environ["MX_STOP_FILE"]
+    deadline = time.time() + 180
+    while not os.path.exists(stop) and time.time() < deadline:
+        telemetry.heartbeat(20, force=True)  # stay healthy while idling
+        time.sleep(0.1)
+    # os._exit: a SIGTERM-killed peer skipped jax.distributed.shutdown,
+    # so running OUR atexit shutdown would block on its barrier until a
+    # timeout error turns this clean exit dirty; telemetry is already
+    # flushed above and the supervisor only needs the exit code
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

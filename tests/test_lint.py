@@ -818,6 +818,95 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# jax-in-handler (metrics endpoint jax-free reachability)
+# ---------------------------------------------------------------------------
+JAXFREE = {"mxnet_tpu/fixture.py": ("Handler.do_GET",)}
+
+
+def _lint_jaxfree(tmp_path, src, jax_free=None):
+    path = tmp_path / "mxnet_tpu" / "fixture.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    findings, stats = mxlint.run_lint(
+        [str(path)], root=str(tmp_path), hot_entries={},
+        env_registry=frozenset(),
+        jax_free_entries=jax_free if jax_free is not None else JAXFREE)
+    return findings, stats
+
+
+def test_jax_in_handler_inline_import_flagged(tmp_path):
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class Handler:
+            def do_GET(self):
+                import jax
+
+                return jax.devices()
+        """)
+    assert "jax-in-handler" in rules_of(findings)
+
+
+def test_jax_in_handler_module_alias_use_flagged(tmp_path):
+    # a module-level `import jax.numpy as jnp` USED in the handler is
+    # the same defect as an inline import
+    findings, _ = _lint_jaxfree(tmp_path, """
+        import jax.numpy as jnp
+
+        class Handler:
+            def do_GET(self):
+                return self._render()
+
+            def _render(self):
+                return jnp.zeros(3)
+        """)
+    assert "jax-in-handler" in rules_of(findings)
+    assert any(f.context == "Handler._render" for f in findings)
+
+
+def test_jax_in_handler_hot_sync_also_checked(tmp_path):
+    # handler entries ride the hot-sync readback checks too: a scrape
+    # must never block on a device value
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class Handler:
+            def do_GET(self):
+                return self.loss.item()
+        """)
+    assert rules_of(findings) == ["hot-sync"]
+
+
+def test_jax_free_handler_clean(tmp_path):
+    findings, _ = _lint_jaxfree(tmp_path, """
+        import json
+
+        class Handler:
+            def do_GET(self):
+                return json.dumps(self._snapshot())
+
+            def _snapshot(self):
+                return {"ok": True}
+        """)
+    assert findings == []
+
+
+def test_stale_jax_free_entry_is_a_finding(tmp_path):
+    # renaming the handler must not silently un-lint the endpoint
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class Handler:
+            def do_GET_renamed(self):
+                return 1
+        """)
+    assert rules_of(findings) == ["stale-hot-entry"]
+    assert "Handler.do_GET" in findings[0].message
+
+
+def test_metrics_server_entries_registered():
+    """The REAL metrics_server handler is under the jax-free rule (and
+    resolves — the full-tree gate below would flag stale-hot-entry if a
+    refactor moved it without updating JAX_FREE_ENTRIES)."""
+    real = mxlint.JAX_FREE_ENTRIES["mxnet_tpu/metrics_server.py"]
+    assert "_Handler.do_GET" in real
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the real tree is lint-clean, fast, at head
 # ---------------------------------------------------------------------------
 def test_full_tree_is_clean_and_fast():

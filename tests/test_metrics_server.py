@@ -1,0 +1,515 @@
+"""Live metrics plane (docs/OBSERVABILITY.md §Live metrics; ISSUE 13):
+the shared OpenMetrics render core and its edge cases, the per-rank
+HTTP endpoint (/metrics /healthz /statusz + portfile), the launch.py
+gang merge with up/staleness gauges, per-request serving traces + SLO
+counters, and bitwise training parity with the endpoint on vs off."""
+import importlib.util
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, metrics_server, nd, telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LAUNCH = os.path.join(_REPO, "tools", "launch.py")
+
+_spec = importlib.util.spec_from_file_location("launch_mod", _LAUNCH)
+launch_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(launch_mod)
+
+# one exposition line: comment or name{labels} value
+_SAMPLE_RE = re.compile(r'^[a-z_][a-z0-9_]*\{[^{}]*\} -?[0-9.eE+-]+$')
+
+
+def _assert_wellformed(body):
+    lines = body.rstrip("\n").splitlines()
+    assert lines[-1] == "# EOF", lines[-3:]
+    assert body.count("# EOF") == 1
+    for line in lines[:-1]:
+        assert line.startswith("# TYPE ") or _SAMPLE_RE.match(line), line
+
+
+@pytest.fixture
+def tele():
+    telemetry.reset()
+    yield telemetry
+    metrics_server.stop()
+    telemetry.reset()
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _serve(tele, tmp_path=None):
+    if tmp_path is not None:
+        tele.enable(str(tmp_path))
+    assert metrics_server.start(0)
+    return f"http://127.0.0.1:{metrics_server.port()}"
+
+
+# ---------------------------------------------------------------------------
+# the shared render core (satellite: formatter edge cases)
+# ---------------------------------------------------------------------------
+def test_render_empty_summary_is_wellformed(tele):
+    # recorder fully disabled, nothing recorded: the exposition must
+    # still parse, end in # EOF, and carry the provenance stamps
+    body = telemetry.render_prometheus(mode="live")
+    _assert_wellformed(body)
+    assert "mx_export_timestamp_seconds" in body
+    assert 'mx_export_mode{rank="0",mode="live"} 1' in body
+
+
+def test_export_mode_distinguishes_atexit_from_live(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    tele.record_step("E", step=1, wall_s=0.01)
+    live = telemetry.render_prometheus(mode="live")
+    path = telemetry.export_prometheus(str(tmp_path / "m.prom"))
+    snap = open(path).read()
+    assert 'mode="live"' in live and 'mode="atexit"' not in live
+    assert 'mode="atexit"' in snap and 'mode="live"' not in snap
+    _assert_wellformed(snap)
+    # the staleness stamp a dashboard ages a dead rank's snapshot by
+    ts = float(re.search(
+        r'mx_export_timestamp_seconds\{rank="0"\} ([0-9.]+)', snap).group(1))
+    assert abs(time.time() - ts) < 60
+
+
+def test_label_escaping_roundtrip(tele, tmp_path):
+    tele.enable(str(tmp_path))
+    nasty = 'Exec"quoted"\\back\\slash'
+    tele.record_step(nasty, step=1, wall_s=0.01)
+    body = telemetry.render_prometheus()
+    _assert_wellformed(body)
+    m = re.search(r'mx_step_total\{rank="0",executor="((?:[^"\\]|\\.)*)"\} 1',
+                  body)
+    assert m, body
+    unescaped = m.group(1).replace(r"\"", '"').replace(r"\\", "\\")
+    assert unescaped == nasty
+
+
+def test_concurrent_scrape_during_flush_no_torn_exposition(tele, tmp_path):
+    """Scrapes racing the recorder (records + flushes + heartbeats on
+    other threads) must every time yield one complete, parseable
+    exposition ending in # EOF — the render reads the locked rollups,
+    so a torn body would mean the formatter itself is racy."""
+    base = _serve(tele, tmp_path)
+    stop = threading.Event()
+    errs = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            telemetry.record_step('E"x\\y', step=i, wall_s=0.001, samples=4)
+            telemetry.record_serve_request(decode_ms=1.0, tokens=2,
+                                           ttft_ms=0.5, request_id=f"r{i}")
+            telemetry.heartbeat(i, force=True)
+            telemetry.flush()
+
+    def scrape():
+        try:
+            for _ in range(25):
+                status, body = _get(f"{base}/metrics")
+                assert status == 200
+                _assert_wellformed(body)
+        except Exception as e:  # surfaces in the main thread's assert
+            errs.append(e)
+
+    churners = [threading.Thread(target=churn, daemon=True)
+                for _ in range(2)]
+    scrapers = [threading.Thread(target=scrape) for _ in range(3)]
+    for t in churners + scrapers:
+        t.start()
+    for t in scrapers:
+        t.join(timeout=60)
+    stop.set()
+    for t in churners:
+        t.join(timeout=10)
+    assert not errs, errs[0]
+
+
+# ---------------------------------------------------------------------------
+# endpoint routes
+# ---------------------------------------------------------------------------
+def test_metrics_route_serves_live_rollups(tele, tmp_path):
+    base = _serve(tele, tmp_path)
+    tele.record_step("ExecA", step=1, wall_s=0.01, samples=8,
+                     inflight_depth=2)
+    status, body = _get(f"{base}/metrics")
+    assert status == 200
+    _assert_wellformed(body)
+    assert 'mx_step_total{rank="0",executor="ExecA"} 1' in body
+    assert 'mode="live"' in body
+    # and the root alias serves the same exposition
+    status2, body2 = _get(f"{base}/")
+    assert status2 == 200 and "mx_export_timestamp_seconds" in body2
+
+
+def test_healthz_ok_then_stale_503(tele, tmp_path):
+    base = _serve(tele, tmp_path)
+    tele.heartbeat(7, force=True)
+    status, body = _get(f"{base}/healthz")
+    snap = json.loads(body)
+    assert status == 200 and snap["healthy"], snap
+    assert snap["last_step"] == 7 and snap["rank"] == 0
+    # age the heartbeat far past the supervisor's staleness rule
+    with telemetry._state.lock:
+        telemetry._state.hb_wall = time.time() - 3600
+    status, body = _get(f"{base}/healthz")
+    snap = json.loads(body)
+    assert status == 503 and not snap["healthy"]
+    assert any("heartbeat" in r for r in snap["reasons"]), snap
+
+
+def test_healthz_without_heartbeat_stays_healthy(tele, tmp_path):
+    # a process that never heartbeat (startup, telemetry off) is not
+    # thereby DEAD — only flowing-then-stopped heartbeats flip 503
+    base = _serve(tele, tmp_path)
+    status, body = _get(f"{base}/healthz")
+    snap = json.loads(body)
+    assert status == 200 and snap["healthy"]
+    assert snap["heartbeat_age_s"] is None
+
+
+def test_statusz_carries_summary_flight_and_health(tele, tmp_path):
+    base = _serve(tele, tmp_path)
+    tele.record_step("ExecA", step=1, wall_s=0.01)
+    tele.record("custom_marker", note="x")
+    status, body = _get(f"{base}/statusz")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["export_mode"] == "live"
+    assert snap["summary"]["steps"]["ExecA"]["count"] == 1
+    assert any(e["kind"] == "custom_marker" for e in snap["flight"])
+    assert snap["health"]["healthy"] is True
+    assert "memwatch" in snap
+
+
+def test_unknown_route_404(tele, tmp_path):
+    base = _serve(tele, tmp_path)
+    status, body = _get(f"{base}/nope")
+    assert status == 404 and "/statusz" in body
+
+
+def test_portfile_written_and_removed(tele, tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_TELEMETRY_DIR", str(tmp_path))
+    assert metrics_server.start(0)
+    pf = metrics_server.portfile_path(str(tmp_path), 0)
+    rec = json.load(open(pf))
+    assert rec["port"] == metrics_server.port() > 0
+    assert rec["pid"] == os.getpid()
+    metrics_server.stop()
+    assert not os.path.exists(pf)
+    assert not metrics_server.enabled() and metrics_server.port() == 0
+
+
+def test_config_port_semantics(monkeypatch):
+    for raw, want in [("", None), ("off", None), ("garbage", None),
+                      ("-1", None), ("0", 0), ("auto", 0), ("9100", 9100)]:
+        monkeypatch.setenv("MX_METRICS_PORT", raw)
+        assert metrics_server._config_port() == want, raw
+    monkeypatch.delenv("MX_METRICS_PORT")
+    assert metrics_server._config_port() is None
+    assert metrics_server.maybe_start() is False  # default: off
+
+
+# ---------------------------------------------------------------------------
+# gang merge (launch.py side, unit level)
+# ---------------------------------------------------------------------------
+def test_merge_expositions_up_staleness_and_single_eof():
+    now = time.time()
+    # rank 0 carries a heartbeat-age gauge: a wedged training loop stops
+    # heartbeating while its HTTP thread keeps rendering fresh export
+    # timestamps — staleness must prefer the DATA age (120s), not the
+    # render age (2s).  rank 1 has no heartbeat: falls back to the
+    # export-timestamp age.
+    body0 = ("# TYPE mx_export_timestamp_seconds gauge\n"
+             f'mx_export_timestamp_seconds{{rank="0"}} {now - 2:.3f}\n'
+             "# TYPE mx_heartbeat_age_seconds gauge\n"
+             'mx_heartbeat_age_seconds{rank="0"} 120.0\n'
+             "# TYPE mx_step_total counter\n"
+             'mx_step_total{rank="0",executor="E"} 5\n'
+             "# EOF\n")
+    body1 = ("# TYPE mx_export_timestamp_seconds gauge\n"
+             f'mx_export_timestamp_seconds{{rank="1"}} {now - 40:.3f}\n'
+             "# TYPE mx_step_total counter\n"
+             'mx_step_total{rank="1",executor="E"} 7\n'
+             "# EOF\n")
+    merged = launch_mod._merge_expositions({0: body0, 1: body1, 2: None})
+    _assert_wellformed(merged)
+    assert 'up{rank="0"} 1' in merged
+    assert 'up{rank="1"} 1' in merged
+    assert 'up{rank="2"} 0' in merged  # dead endpoint
+    assert 'mx_step_total{rank="0",executor="E"} 5' in merged
+    assert 'mx_step_total{rank="1",executor="E"} 7' in merged
+    # duplicate TYPE lines collapse to one declaration per metric
+    assert merged.count("# TYPE mx_step_total counter") == 1
+    st = {m.group(1): float(m.group(2)) for m in re.finditer(
+        r'mx_scrape_staleness_seconds\{rank="(\d)"\} ([0-9.]+)', merged)}
+    assert st["0"] == 120.0, st          # heartbeat age wins
+    assert 35.0 < st["1"] < 60.0, st     # export-timestamp fallback
+    # families stay uninterrupted blocks (the OpenMetrics grouping rule)
+    seen, last = set(), None
+    for line in merged.rstrip().splitlines():
+        if line.startswith("# EOF"):
+            continue
+        name = line.split()[2] if line.startswith("# TYPE ") \
+            else line.split("{", 1)[0]
+        if name != last:
+            assert name not in seen, f"family {name} interleaved"
+            seen.add(name)
+            last = name
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: scraping must not perturb training
+# ---------------------------------------------------------------------------
+def _train_weights(tele, tmp_path, endpoint):
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    telemetry.reset()
+    telemetry.enable(str(tmp_path))
+    stop = th = None
+    if endpoint:
+        base = _serve(tele)
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                _get(f"{base}/metrics")
+                _get(f"{base}/healthz")
+                stop.wait(0.01)
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(16, 8).astype(np.float32))
+    y = nd.array(rng.rand(16, 4).astype(np.float32))
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    step = DataParallelStep(
+        net, gluon.loss.L2Loss(),
+        mesh=local_mesh(devices=[mx.current_context().jax_device]),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.05})
+    losses = []
+    for _ in range(6):
+        losses.append(step.step(x, y))
+    step.drain()
+    losses = [float(l) for l in losses]
+    step.sync_to_block()
+    # keyed by param order, not name: gluon's global name counter differs
+    # between the two runs in one process (dense0 vs dense1)
+    weights = [p.data().asnumpy().tobytes()
+               for _k, p in sorted(net.collect_params().items())]
+    if endpoint:
+        stop.set()
+        th.join(timeout=10)
+        metrics_server.stop()
+    return losses, weights
+
+
+def test_losses_and_weights_bitwise_identical_endpoint_on_off(tele,
+                                                              tmp_path):
+    on_losses, on_w = _train_weights(tele, tmp_path / "on", endpoint=True)
+    off_losses, off_w = _train_weights(tele, tmp_path / "off",
+                                       endpoint=False)
+    assert on_losses == off_losses
+    assert on_w == off_w, "weights diverged with the endpoint scraped"
+
+
+# ---------------------------------------------------------------------------
+# serving request-trace e2e (acceptance): queue->prefill->decode spans
+# per request id in the Perfetto export, TTFT p50/p99 + SLO violations
+# in trace_report --json and in the prometheus exposition
+# ---------------------------------------------------------------------------
+def test_serving_request_trace_e2e(tele, tmp_path, monkeypatch):
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+    monkeypatch.setenv("MX_SERVE_SLO_TTFT_MS", "0.001")  # everything trips
+    telemetry.enable(str(tmp_path))
+    mx.random.seed(0)
+    net = Transformer(16, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=20, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    adapter = TransformerAdapter(net, src_max_len=8)
+    eng = ServingEngine(adapter, slots=2, page_size=4, max_len=10,
+                        stream_every=2)
+    rng = np.random.RandomState(0)
+    reqs = [Request(rng.randint(3, 16, n).astype(np.int32),
+                    max_new_tokens=m, bos_id=1, eos_id=2,
+                    request_id=f"q{i}")
+            for i, (n, m) in enumerate([(3, 4), (6, 6), (4, 3), (2, 5)])]
+    out = eng.serve(reqs, arrival_steps=[0, 0, 2, 4])  # mixed + mid-flight
+    assert set(out) == {f"q{i}" for i in range(4)}
+    telemetry.flush()
+
+    # Perfetto export: every request id owns queue/prefill/decode slices
+    trace_path = telemetry.export_chrome_trace(str(tmp_path))
+    trace = json.load(open(trace_path))["traceEvents"]
+    by_req = {}
+    for ev in trace:
+        rid = (ev.get("args") or {}).get("request_id")
+        if rid is not None and ev.get("ph") == "X":
+            by_req.setdefault(rid, set()).add(ev["name"])
+    for i in range(4):
+        assert {"serve_queue", "serve_prefill",
+                "serve_decode"} <= by_req.get(f"q{i}", set()), by_req
+
+    # trace_report --json: the serving section
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    rep = json.loads(res.stdout)
+    srv = rep["serving"]
+    assert srv["requests"] == 4
+    assert srv["ttft_p50_ms"] > 0 and srv["ttft_p99_ms"] >= \
+        srv["ttft_p50_ms"]
+    assert srv["slo_violations"]["ttft"] == 4  # the injected violations
+    ids = {r["id"] for r in srv["per_request"]}
+    assert ids == {f"q{i}" for i in range(4)}
+    for row in srv["per_request"]:
+        assert row["decode_ms"] >= 0 and row["tokens"] > 0
+    occ = srv["slot_occupancy"]
+    assert occ["samples"] > 0 and 1 <= occ["max_active_slots"] <= 2
+    # human rendering has the section too
+    res_txt = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert "serving" in res_txt.stdout and "SLO violations: ttft=4" in \
+        res_txt.stdout, res_txt.stdout
+
+    # ...and the live exposition counts them
+    body = telemetry.render_prometheus()
+    assert 'mx_serve_slo_violations_total{rank="0",stage="ttft"} 4' in body
+    assert 'mx_serve_slo_violations_total{rank="0",stage="tpot"} 0' in body
+    assert "mx_serve_ttft_p50_ms" in body
+
+
+# ---------------------------------------------------------------------------
+# 2-rank gang e2e (acceptance): live per-rank endpoints during training,
+# merged gang /metrics with both ranks' counters + up gauges, and a
+# killed rank flipping up/healthz within one scrape
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _poll(fn, deadline, why, sleep=0.2):
+    while time.time() < deadline:
+        out = fn()
+        if out is not None:
+            return out
+        time.sleep(sleep)
+    raise AssertionError(f"timed out waiting for {why}")
+
+
+@pytest.mark.dist
+def test_two_rank_gang_live_metrics_and_up_flip(tmp_path):
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    stop_file = tmp_path / "stop"
+    gang_port = _free_port()
+    env = dict(os.environ, MX_TELEMETRY_DIR=str(tdir),
+               MX_HEARTBEAT_SEC="0.2", MX_TELEMETRY_FLUSH_SEC="0.2",
+               MX_STOP_FILE=str(stop_file))
+    env.pop("MX_METRICS_PORT", None)  # the supervisor exports it
+    cmd = [sys.executable, _LAUNCH, "-n", "2", "--force-cpu",
+           "--metrics-port", str(gang_port), "--",
+           sys.executable,
+           os.path.join(_REPO, "tests", "dist", "metrics_worker.py")]
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        deadline = time.time() + 210
+
+        def ports():
+            out = {}
+            for r in (0, 1):
+                pf = tdir / f"metrics-port-{r}.json"
+                if pf.exists():
+                    out[r] = json.load(open(pf))
+            return out if len(out) == 2 else None
+
+        ends = _poll(ports, deadline, "both rank portfiles")
+
+        # each rank serves live /metrics + /healthz while running
+        for r, rec in ends.items():
+            base = f"http://127.0.0.1:{rec['port']}"
+
+            def rank_training(base=base, r=r):
+                status, body = _get(f"{base}/metrics")
+                return body if status == 200 and \
+                    f'mx_step_total{{rank="{r}"' in body else None
+
+            body = _poll(rank_training, deadline, f"rank {r} step counters")
+            _assert_wellformed(body)
+            assert 'mode="live"' in body
+            status, hz = _get(f"{base}/healthz")
+            assert status == 200 and json.loads(hz)["healthy"], hz
+
+        # the supervisor's merged gang exposition
+        def merged_ready():
+            status, body = _get(
+                f"http://127.0.0.1:{gang_port}/metrics")
+            ok = (status == 200 and 'up{rank="0"} 1' in body
+                  and 'up{rank="1"} 1' in body
+                  and 'mx_step_total{rank="0"' in body
+                  and 'mx_step_total{rank="1"' in body)
+            return body if ok else None
+
+        merged = _poll(merged_ready, deadline, "merged gang metrics")
+        _assert_wellformed(merged)
+        assert "mx_scrape_staleness_seconds" in merged
+
+        # kill rank 1: its up gauge and healthz flip on the next scrape
+        os.kill(ends[1]["pid"], signal.SIGTERM)
+
+        def rank1_down():
+            status, body = _get(
+                f"http://127.0.0.1:{gang_port}/metrics")
+            return body if status == 200 and 'up{rank="1"} 0' in body \
+                else None
+
+        merged = _poll(rank1_down, time.time() + 30, "up flip for rank 1")
+        assert 'up{rank="0"} 1' in merged  # the survivor is still live
+        _assert_wellformed(merged)
+        status, hz = _get(f"http://127.0.0.1:{gang_port}/healthz")
+        snap = json.loads(hz)
+        assert status == 503 and not snap["healthy"], snap
+        assert not snap["ranks"]["1"]["healthy"]
+        assert snap["ranks"]["0"]["healthy"]
+
+        stop_file.write_text("go")
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 0, (out[-2000:], err[-2000:])
+        assert "gang /metrics on" in err
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)

@@ -30,7 +30,10 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 # Exit code a worker uses after a SIGTERM-triggered final checkpoint
@@ -254,6 +257,226 @@ def _reexport_trace(tdir) -> None:
               f"{(res.stderr or '').strip()[-500:]}", file=sys.stderr)
 
 
+# ---------------------------------------------------------------------------
+# gang metrics plane (mxnet_tpu/metrics_server.py serves the per-rank
+# endpoints and writes metrics-port-<R>.json portfiles next to the
+# heartbeats; the filename pattern is duplicated here because this
+# launcher must stay importable without jax/mxnet_tpu — keep in sync
+# with metrics_server.portfile_path)
+# ---------------------------------------------------------------------------
+SCRAPE_TIMEOUT = 2.0
+
+
+def _rank_endpoint(tdir, rank):
+    """http://host:port for a rank's live metrics endpoint (from its
+    portfile), or None when the rank never advertised one.  The
+    portfile's ``host`` is the connectable address the rank bound
+    (MX_METRICS_HOST; wildcard binds advertise loopback) — hardcoding
+    127.0.0.1 would break the whole supervisor plane for a
+    specific-NIC bind."""
+    try:
+        with open(os.path.join(tdir, f"metrics-port-{rank}.json")) as f:
+            rec = json.load(f)
+        port = int(rec["port"])
+        host = str(rec.get("host") or "127.0.0.1")
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return f"http://{host}:{port}"
+
+
+def _http_get(url, timeout=SCRAPE_TIMEOUT):
+    """(status, body) for a GET, or (None, error string) when the
+    endpoint is unreachable.  5xx bodies are read, not raised — a 503
+    /healthz verdict carries the diagnosis."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, e.read().decode("utf-8", "replace")
+        except OSError:
+            return e.code, ""
+    except (OSError, ValueError) as e:
+        return None, str(e)
+
+
+def _scrape_ranks(tdir, num, route, timeout=SCRAPE_TIMEOUT):
+    """{rank: (status, body) or (None, reason)} — all ranks scraped
+    CONCURRENTLY, so one merged request costs ~one SCRAPE_TIMEOUT even
+    when several wedged ranks accept TCP and stall: a sequential walk of
+    an 8-rank gang could take 8x the timeout, blowing the Prometheus
+    scrape deadline exactly during the incident being observed."""
+    out = {}
+    threads = []
+    for rank in range(num):
+        base = _rank_endpoint(tdir, rank)
+        if base is None:
+            out[rank] = (None, "no metrics portfile")
+            continue
+
+        def fetch(rank=rank, base=base):
+            out[rank] = _http_get(f"{base}{route}", timeout=timeout)
+
+        t = threading.Thread(target=fetch, daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + timeout + 1.0
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    for rank in range(num):
+        out.setdefault(rank, (None, "scrape thread timed out"))
+    return out
+
+
+def _merge_expositions(per_rank):
+    """Merge per-rank OpenMetrics bodies ({rank: body or None}) into ONE
+    gang exposition: rank samples pass through (they already carry
+    rank="R" labels) but are REGROUPED by metric family — the
+    OpenMetrics content type promises each family is one uninterrupted
+    block, and strict parsers (Prometheus, promtool) reject interleaved
+    families, which naive rank-by-rank concatenation produces the
+    moment two ranks are up.  Each rank contributes an ``up`` gauge
+    (1 = scraped, 0 = endpoint down/unreachable) and an
+    ``mx_scrape_staleness_seconds`` gauge measuring DATA age: the rank's
+    own ``mx_heartbeat_age_seconds`` when present — a wedged training
+    loop stops heartbeating, so this grows even while the rank's HTTP
+    thread keeps answering with fresh render timestamps — else the age
+    of its ``mx_export_timestamp_seconds`` stamp (meaningful for a
+    never-heartbeat process: how old the exposition itself is)."""
+    out = ["# TYPE up gauge"]
+    staleness = {}
+    now = time.time()
+    for rank in sorted(per_rank):
+        body = per_rank[rank]
+        out.append(f'up{{rank="{rank}"}} {1 if body is not None else 0}')
+        if body is None:
+            continue
+        hb_age = export_age = None
+        for line in body.splitlines():
+            try:
+                if line.startswith("mx_heartbeat_age_seconds"):
+                    hb_age = max(0.0, float(line.split()[-1]))
+                elif line.startswith("mx_export_timestamp_seconds"):
+                    export_age = max(0.0, now - float(line.split()[-1]))
+            except (ValueError, IndexError):
+                pass
+        if hb_age is not None:
+            staleness[rank] = hb_age
+        elif export_age is not None:
+            staleness[rank] = export_age
+    if staleness:
+        out.append("# TYPE mx_scrape_staleness_seconds gauge")
+        for rank, age in sorted(staleness.items()):
+            out.append(f'mx_scrape_staleness_seconds{{rank="{rank}"}} '
+                       f"{round(age, 3)}")
+    # family name -> [type line, sample, sample, ...] in first-seen order
+    families = {}
+    for rank in sorted(per_rank):
+        body = per_rank[rank]
+        if body is None:
+            continue
+        for line in body.splitlines():
+            if not line or line.startswith("# EOF"):
+                continue  # ONE terminator, appended below
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                name = parts[2] if len(parts) > 2 else line
+                families.setdefault(name, [line])
+                continue
+            if line.startswith("#"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            families.setdefault(name, []).append(line)
+    for lines in families.values():
+        out.extend(lines)
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+class _GangMetricsServer:
+    """The supervisor's merged gang ``/metrics`` (+ ``/healthz``):
+    scrape-on-demand over every rank's discovered portfile endpoint, so
+    one Prometheus target covers the whole gang and a dead rank flips
+    its ``up`` gauge within one scrape interval.  Stdlib-only, daemon
+    threads, and inert when the telemetry dir (portfile home) is
+    unknown."""
+
+    def __init__(self, tdir, num_workers, port):
+        self.tdir = tdir
+        self.num = num_workers  # supervisor updates on elastic resize
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "mxnet-tpu-gang-metrics/1"
+
+            def do_GET(self):  # noqa: N802 (http.server contract)
+                route = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if route in ("/", "/metrics"):
+                    code, ctype, body = outer.merged_metrics()
+                elif route == "/healthz":
+                    code, ctype, body = outer.merged_healthz()
+                else:
+                    code, ctype, body = (404, "text/plain; charset=utf-8",
+                                         f"no such route {route!r}; try "
+                                         "/metrics /healthz\n")
+                payload = body.encode("utf-8", "replace")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes must not interleave with [rank N] logs
+
+        # MX_METRICS_HOST (same knob the per-rank endpoint honors): the
+        # merged endpoint is the one DESIGNED to be the external scrape
+        # target — a cross-host Prometheus needs 0.0.0.0 here, while the
+        # per-rank scrapes stay on 127.0.0.1 via the portfiles
+        host = os.environ.get("MX_METRICS_HOST", "127.0.0.1")
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="gang-metrics", daemon=True)
+        self._thread.start()
+
+    def merged_metrics(self):
+        scraped = _scrape_ranks(self.tdir, self.num, "/metrics")
+        per_rank = {rank: (text if status == 200 else None)
+                    for rank, (status, text) in scraped.items()}
+        return (200,
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8",
+                _merge_expositions(per_rank))
+
+    def merged_healthz(self):
+        ranks = {}
+        all_ok = True
+        for rank, (status, text) in sorted(
+                _scrape_ranks(self.tdir, self.num, "/healthz").items()):
+            if status is None:
+                ranks[rank] = {"healthy": False,
+                               "reasons": [f"endpoint unreachable: {text}"]}
+                all_ok = False
+                continue
+            try:
+                ranks[rank] = json.loads(text)
+            except ValueError:
+                ranks[rank] = {"healthy": False,
+                               "reasons": ["unparseable /healthz body"]}
+            if not ranks[rank].get("healthy"):
+                all_ok = False
+        body = json.dumps({"healthy": all_ok,
+                           "ranks": {str(r): v for r, v in ranks.items()}})
+        return (200 if all_ok else 503, "application/json", body + "\n")
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
 class _HeartbeatMonitor:
     """Poll per-rank heartbeat files so a hung/slow rank is diagnosed
     ("rank 2 last heartbeat 45s ago at step 130") BEFORE the gang is torn
@@ -279,12 +502,42 @@ class _HeartbeatMonitor:
         self._stale = set()
         self._next_poll = 0.0
         self._gang_start = 0.0
+        # rank -> parsed /statusz body captured by snapshot_statusz()
+        # while the rank was still alive (before any kill), and the
+        # /healthz verdict string captured at the same live moment —
+        # diagnose() runs after every rank is reaped, when a live probe
+        # could only ever say "endpoint unreachable"
+        self._statusz = {}
+        self._healthz = {}
 
     def gang_started(self) -> None:
         """Called at each (re)spawn: heartbeats older than this incarnation
         are leftovers of the previous gang, not evidence of a hung rank."""
         self._gang_start = time.time()
         self._stale.clear()
+        # pre-teardown snapshots belong to ONE incarnation: a later
+        # crash must not print a previous gang's state as its own
+        self._statusz.clear()
+        self._healthz.clear()
+        # drop the previous incarnation's metrics portfiles too: the OS
+        # can hand a dead rank's ephemeral port to ANOTHER rank of the
+        # new gang, and a scrape through the stale file would attribute
+        # that rank's exposition to the wrong (possibly dead) rank.
+        # Workers rewrite their portfile at import.  Same hygiene for
+        # the on-disk statusz-<R>.json snapshots: a reader of the final
+        # post-mortem must not find a previous incarnation's state.
+        if self.dir is not None:
+            try:
+                for name in os.listdir(self.dir):
+                    if (name.startswith("metrics-port-")
+                            or name.startswith("statusz-")) and \
+                            name.endswith(".json"):
+                        try:
+                            os.unlink(os.path.join(self.dir, name))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
 
     def _read(self, rank: int):
         try:
@@ -328,15 +581,83 @@ class _HeartbeatMonitor:
             if age > self.stale_after:
                 if rank not in self._stale:
                     self._stale.add(rank)
+                    # the one moment the distinction is live: a hung
+                    # PROCESS keeps answering /healthz (503, stale
+                    # heartbeat); a dead ENDPOINT refuses the connection
+                    verdict = self._healthz_verdict(rank)
+                    self._healthz[rank] = verdict
                     print(f"launch.py: rank {rank} last heartbeat "
                           f"{age:.1f}s ago at step {rec.get('step')} — "
-                          "suspect hung/slow rank", file=sys.stderr)
+                          f"suspect hung/slow rank; /healthz: {verdict}",
+                          file=sys.stderr)
             else:
                 self._stale.discard(rank)
 
+    @staticmethod
+    def _render_verdict(status, text) -> str:
+        """One-line /healthz verdict from a (status, body) probe result
+        — 'hung process' (stale heartbeat, endpoint answering) and
+        'dead endpoint' (nothing listening) are different post-mortems
+        and the supervisor log must distinguish them."""
+        if status is None:
+            return f"endpoint unreachable ({text})"
+        try:
+            snap = json.loads(text)
+        except ValueError:
+            return f"endpoint answered {status} with unparseable body"
+        verdict = "ok" if snap.get("healthy") else \
+            "; ".join(snap.get("reasons") or ["unhealthy"])
+        return (f"{verdict} (HTTP {status}, step {snap.get('last_step')}, "
+                f"inflight {snap.get('inflight_depth')})")
+
+    def _healthz_verdict(self, rank) -> str:
+        base = _rank_endpoint(self.dir, rank)
+        if base is None:
+            return "no live endpoint (MX_METRICS_PORT off or no portfile)"
+        return self._render_verdict(*_http_get(f"{base}/healthz",
+                                               timeout=1.0))
+
+    def snapshot_statusz(self) -> None:
+        """Snapshot /statusz from every rank whose endpoint still
+        answers — called BEFORE the supervisor kills anything, so the
+        survivors' live state (last steps, flight tails, in-flight
+        depth) is preserved exactly as it was when a peer died.  Full
+        bodies land in ``statusz-<R>.json`` next to the heartbeats;
+        diagnose() echoes the one-line digest.  Both routes scrape all
+        ranks CONCURRENTLY (_scrape_ranks): this runs on the teardown
+        path, where several wedged ranks probed serially would delay
+        SIGTERM by num_ranks x timeout right in the middle of the
+        incident."""
+        if self.dir is None:
+            return
+        healthz = _scrape_ranks(self.dir, self.num, "/healthz", timeout=1.0)
+        statusz = _scrape_ranks(self.dir, self.num, "/statusz", timeout=1.0)
+        for rank in range(self.num):
+            status, text = healthz.get(rank, (None, "?"))
+            if (status, text) != (None, "no metrics portfile"):
+                # captured NOW, while an answer still means something —
+                # by diagnose() time every rank is reaped and a live
+                # probe can only say "endpoint unreachable"
+                self._healthz.setdefault(
+                    rank, self._render_verdict(status, text))
+            status, text = statusz.get(rank, (None, ""))
+            if status != 200:
+                continue
+            try:
+                self._statusz[rank] = json.loads(text)
+            except ValueError:
+                continue
+            try:
+                with open(os.path.join(self.dir,
+                                       f"statusz-{rank}.json"), "w") as f:
+                    f.write(text)
+            except OSError:
+                pass
+
     def diagnose(self) -> None:
-        """After a gang death: last heartbeat per rank + flight tail +
-        the gang-wide trace report (straggler flags, step breakdown)."""
+        """After a gang death: last heartbeat per rank + the live
+        /healthz verdict + flight tail + the gang-wide trace report
+        (straggler flags, step breakdown)."""
         if self.dir is None:
             return
         saw_events = False
@@ -344,8 +665,24 @@ class _HeartbeatMonitor:
             rec = self._read(rank)
             if rec is not None:
                 age = time.time() - float(rec.get("time", 0.0))
+                # prefer the verdict captured while the rank was alive
+                # (poll's stale callout or the pre-teardown snapshot);
+                # a live probe now only distinguishes "endpoint already
+                # gone" from "endpoint outlived the process"
+                verdict = self._healthz.get(rank) or \
+                    self._healthz_verdict(rank)
                 print(f"launch.py: rank {rank} last heartbeat {age:.1f}s "
-                      f"ago at step {rec.get('step')}", file=sys.stderr)
+                      f"ago at step {rec.get('step')}; /healthz: "
+                      f"{verdict}", file=sys.stderr)
+            snap = self._statusz.get(rank)
+            if snap is not None:
+                health = snap.get("health") or {}
+                print(f"launch.py: rank {rank} pre-teardown /statusz "
+                      f"(statusz-{rank}.json): step "
+                      f"{health.get('last_step')}, inflight "
+                      f"{health.get('inflight_depth')}, "
+                      f"{len(snap.get('flight') or [])} flight events",
+                      file=sys.stderr)
             tail = _flight_tail(self.dir, rank)
             if tail:
                 saw_events = True
@@ -480,6 +817,8 @@ def _wait_gang(procs, term_timeout: float, monitor=None, regrow_after=None):
         if (deadline is not None and regrow_after is not None and rc == 0
                 and len(alive) == len(procs)
                 and time.monotonic() >= deadline):
+            if monitor is not None:
+                monitor.snapshot_statusz()
             _terminate_gang(alive, term_timeout)
             return 0, True
         for p in list(alive):
@@ -489,6 +828,11 @@ def _wait_gang(procs, term_timeout: float, monitor=None, regrow_after=None):
             alive.remove(p)
             if r != 0 and rc == 0:
                 rc = r
+                # survivors' live state BEFORE any kill: the statusz
+                # snapshot is the only record of what the still-running
+                # ranks were doing when the culprit died
+                if monitor is not None:
+                    monitor.snapshot_statusz()
                 _terminate_gang(alive, term_timeout)
         if alive:
             if monitor is not None:
@@ -514,7 +858,8 @@ def launch_local(num_workers: int, command, env_extra=None,
                  force_cpu: bool = False, max_restarts: int = 0,
                  term_timeout: float = 10.0, backoff: float = 1.0,
                  elastic: bool = False, min_workers: int = 1,
-                 initial_workers=None, regrow_after: float = 0.0) -> int:
+                 initial_workers=None, regrow_after: float = 0.0,
+                 metrics_port=None) -> int:
     """Spawn num_workers processes of `command` on this host and supervise
     the gang: on any worker death the remaining ranks are torn down
     (SIGTERM, bounded wait, SIGKILL) and — up to max_restarts times — the
@@ -538,20 +883,69 @@ def launch_local(num_workers: int, command, env_extra=None,
     the full target — a returned host joining back.  A re-admitted rank
     that keeps dying simply shrinks the gang again (probation loop).
     Only when the budget is exhausted AT ``min_workers`` does the job
-    fail."""
+    fail.
+
+    ``metrics_port`` (``--metrics-port``; docs/OBSERVABILITY.md §Live
+    metrics) serves a merged gang ``/metrics`` on that port (0 =
+    ephemeral, logged): the supervisor discovers each rank's live
+    endpoint via its ``metrics-port-<R>.json`` portfile under
+    ``MX_TELEMETRY_DIR``, scrapes them on demand, and re-serves one
+    exposition with per-rank ``up``/``mx_scrape_staleness_seconds``
+    gauges; workers get ``MX_METRICS_PORT=0`` exported (ephemeral,
+    unless the caller already pinned one)."""
+    monitor = _HeartbeatMonitor(num_workers, env_extra)
+    gang_metrics = None
+    if metrics_port is not None:
+        if monitor.dir is None:
+            print("launch.py: --metrics-port needs MX_TELEMETRY_DIR (the "
+                  "portfile home) — gang /metrics disabled", file=sys.stderr)
+        else:
+            try:
+                gang_metrics = _GangMetricsServer(monitor.dir, num_workers,
+                                                  metrics_port)
+            except OSError as e:
+                # observability must not take the launch down: same
+                # policy as the per-rank endpoint's failed-bind warning
+                print(f"launch.py: gang /metrics failed to bind port "
+                      f"{metrics_port}: {e} — gang metrics disabled",
+                      file=sys.stderr)
+            else:
+                print(f"launch.py: gang /metrics on "
+                      f"http://127.0.0.1:{gang_metrics.port}/metrics "
+                      "(merged per-rank scrape + up/staleness gauges)",
+                      file=sys.stderr)
+    try:
+        return _supervise(num_workers, command, env_extra, force_cpu,
+                          max_restarts, term_timeout, backoff, elastic,
+                          min_workers, initial_workers, regrow_after,
+                          monitor, gang_metrics)
+    finally:
+        if gang_metrics is not None:
+            gang_metrics.close()
+
+
+def _supervise(num_workers, command, env_extra, force_cpu, max_restarts,
+               term_timeout, backoff, elastic, min_workers, initial_workers,
+               regrow_after, monitor, gang_metrics):
     incarnation = 0      # cumulative MX_RESTART_COUNT across resizes
     attempt = 0          # restart budget used at the CURRENT world size
     target = num_workers
     world = min(target, max(1, int(initial_workers or target)))
-    # a degraded FIRST incarnation is not a resize: nothing to export
     prev_world = None
     history = []  # (incarnation, world, [per-rank exit codes])
-    monitor = _HeartbeatMonitor(num_workers, env_extra)
     while True:
         port = _free_port()
         monitor.num = world
         monitor.gang_started()
+        if gang_metrics is not None:
+            gang_metrics.num = world
         spawn_env = dict(env_extra or {})
+        if gang_metrics is not None and "MX_METRICS_PORT" not in spawn_env \
+                and not os.environ.get("MX_METRICS_PORT"):
+            # workers bind ephemeral ports and advertise them via
+            # portfiles; the supervisor's merged endpoint is the one
+            # stable scrape target
+            spawn_env["MX_METRICS_PORT"] = "0"
         if elastic:
             spawn_env["MX_ELASTIC"] = "1"
             if prev_world is not None and prev_world != world:
@@ -668,6 +1062,14 @@ def main(argv=None) -> int:
                                       "ranks (a fleet that came up "
                                       "degraded); pairs with "
                                       "--regrow-after to grow toward -n")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                    help="serve a merged gang /metrics (+ /healthz) on "
+                         "port P (0 = ephemeral, logged at startup): "
+                         "per-rank live endpoints are discovered via "
+                         "metrics-port-<R>.json portfiles under "
+                         "MX_TELEMETRY_DIR and re-served as one "
+                         "exposition with per-rank up/staleness gauges "
+                         "(docs/OBSERVABILITY.md §Live metrics)")
     ap.add_argument("--regrow-after", type=float, default=0.0, metavar="S",
                     help="elastic: after S seconds of healthy running "
                          "below the -n target, preempt the gang (final "
@@ -688,6 +1090,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if args.max_restarts < 0:
         ap.error("--max-restarts must be >= 0")
+    if args.metrics_port is not None and args.metrics_port < 0:
+        ap.error("--metrics-port must be >= 0 (0 = ephemeral)")
     if args.min_workers < 1 or args.min_workers > args.num_workers:
         ap.error("--min-workers must be in [1, num-workers]")
     if args.initial_workers is not None and not (
@@ -703,7 +1107,8 @@ def main(argv=None) -> int:
                         elastic=args.elastic,
                         min_workers=args.min_workers,
                         initial_workers=args.initial_workers,
-                        regrow_after=args.regrow_after)
+                        regrow_after=args.regrow_after,
+                        metrics_port=args.metrics_port)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,13 @@ full catalog with provenance):
                        no justification comment
   env-unregistered     a quoted MX_*/MXNET_* use-site absent from
                        env_vars.ENV_VARS (registry drift guard)
+  jax-in-handler       jax import/use reachable from a declared jax-free
+                       handler entry point (PR 13: the metrics endpoint
+                       serves from the telemetry recorder's locked
+                       rollups on a daemon thread — touching jax there
+                       can deadlock runtime init or force a device sync
+                       under the training loop); these entries also get
+                       the full hot-sync readback checks
 
 Suppression: `# mxlint: disable=rule[,rule] <justification>` on the
 flagged line (or alone on the line above) silences the finding; an
@@ -69,6 +76,8 @@ RULES = {
                            "without a common lock",
     "silent-except": "broad except:pass with no telemetry or justification",
     "env-unregistered": "quoted MX_*/MXNET_* use-site not in ENV_VARS",
+    "jax-in-handler": "jax import/use reachable from a jax-free handler "
+                      "entry point",
     "bad-suppression": "mxlint suppression naming an unknown rule",
     "stale-hot-entry": "configured hot-path entry point no longer resolves",
     "syntax-error": "file failed to parse",
@@ -92,6 +101,16 @@ HOT_PATH_ENTRIES = {
     # state through the compiled step and admits the lazy token handle;
     # a host sync here would serialize the whole serving pipeline
     "mxnet_tpu/serving/engine.py": ("ServingEngine._dispatch_step",),
+}
+
+# HTTP handler threads that must NEVER touch jax (repo-relative path ->
+# function qualnames): the live metrics endpoint serves the telemetry
+# recorder's locked rollups only — a jax import there can deadlock
+# against runtime init, and any device readback stalls the training
+# loop from a scrape.  Reachable functions get the hot-sync readback
+# checks PLUS a lexical jax import/alias-use scan (jax-in-handler).
+JAX_FREE_ENTRIES = {
+    "mxnet_tpu/metrics_server.py": ("_Handler.do_GET",),
 }
 
 # the shard_map_compat shim's home — the ONLY file allowed to touch
@@ -241,12 +260,14 @@ def _docstring_nodes(nodes):
 # ---------------------------------------------------------------------------
 class FileLint:
     def __init__(self, abspath, relpath, text, env_registry, hot_entries,
-                 active_rules):
+                 active_rules, jax_free_entries=None):
         self.path = relpath
         self.text = text
         self.lines = text.splitlines()
         self.env_registry = env_registry
         self.hot_entries = hot_entries
+        self.jax_free = (jax_free_entries if jax_free_entries is not None
+                         else JAX_FREE_ENTRIES)
         self.active = active_rules
         self.findings = []
         self.suppressed = 0
@@ -371,6 +392,7 @@ class FileLint:
             # hot-sync + retrace-hazard share the reachability pass
             ("hot-sync", self.rule_hot_path),
             ("retrace-hazard", self.rule_static_argnums),
+            ("jax-in-handler", self.rule_jax_free),
         )
         for rule, fn in passes:
             if rule in self.active or (
@@ -808,6 +830,65 @@ class FileLint:
                 "float() inside the per-step dispatch path — on a device "
                 "value this is a hidden blocking readback")
 
+    # -- jax-in-handler: jax-free reachability ----------------------------
+    def _is_jax_module(self, name) -> bool:
+        return name == "jax" or (name or "").startswith("jax.")
+
+    def rule_jax_free(self):
+        entries = self.jax_free.get(self.path)
+        if not entries:
+            return
+        for q in entries:
+            if q not in self.scopes.functions:
+                self._emit(
+                    "stale-hot-entry", 1, 0, q,
+                    f"jax-free entry point {q!r} (JAX_FREE_ENTRIES in "
+                    f"tools/mxlint.py) does not resolve in this file — "
+                    f"update the entry list to the renamed/moved handler")
+        # aliases bound to the jax module anywhere in the file: a
+        # module-level `import jax as j` used inside the handler is the
+        # same defect as an inline import
+        jax_aliases = {alias for alias, mod in self.scopes.mod_aliases.items()
+                       if self._is_jax_module(mod)}
+        jax_names = {name for name, target in self.scopes.from_names.items()
+                     if self._is_jax_module(target.rsplit(".", 1)[0])
+                     or target.startswith("jax.")}
+        reach = self._reachable_from(entries)
+        for qual in sorted(reach):
+            fn = self.scopes.functions[qual]
+            for node in self._nodes_in(fn):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if self._is_jax_module(a.name):
+                            self._emit(
+                                "jax-in-handler", node.lineno,
+                                node.col_offset, qual,
+                                "jax import inside a jax-free handler — "
+                                "the metrics endpoint must serve the "
+                                "recorder's rollups only (no runtime "
+                                "init, no device sync, from a scrape)")
+                elif isinstance(node, ast.ImportFrom):
+                    if self._is_jax_module(node.module or ""):
+                        self._emit(
+                            "jax-in-handler", node.lineno, node.col_offset,
+                            qual,
+                            "jax import inside a jax-free handler — "
+                            "serve the recorder's rollups only")
+                elif isinstance(node, ast.Name) and \
+                        (node.id in jax_aliases or node.id in jax_names):
+                    self._emit(
+                        "jax-in-handler", node.lineno, node.col_offset,
+                        qual,
+                        f"{node.id!r} resolves to jax — a jax-free "
+                        "handler must not reach the runtime (serve the "
+                        "recorder's rollups only)")
+                elif isinstance(node, ast.Call):
+                    # the handler also gets the full hot-sync readback
+                    # checks: .item()/np.asarray()/memory_stats() from a
+                    # scrape thread stalls the training loop just as a
+                    # per-step sync would
+                    self._check_sync_call(node, qual)
+
     # -- retrace-hazard part 2: unhashable static args --------------------
     def rule_static_argnums(self):
         jitted = {}  # name -> static positions
@@ -905,11 +986,12 @@ def _rel(path, root):
 
 
 def run_lint(paths=None, root=None, rules=None, hot_entries=None,
-             env_registry=None):
+             env_registry=None, jax_free_entries=None):
     """Analyze `paths` (files or dirs); returns (findings, stats).
 
     `rules`: iterable restricting which rules run (default: all).
-    `hot_entries`/`env_registry`: overrides for tests/fixtures.
+    `hot_entries`/`env_registry`/`jax_free_entries`: overrides for
+    tests/fixtures.
     """
     root = root or REPO
     paths = list(paths) if paths else list(DEFAULT_PATHS)
@@ -926,6 +1008,8 @@ def run_lint(paths=None, root=None, rules=None, hot_entries=None,
         registry_missing = env_registry is None and \
             "env-unregistered" in active
     entries = hot_entries if hot_entries is not None else HOT_PATH_ENTRIES
+    jax_free = (jax_free_entries if jax_free_entries is not None
+                else JAX_FREE_ENTRIES)
     findings, nfiles, suppressed = [], 0, 0
     for ap in iter_py_files(paths, root):
         rel = _rel(ap, root)
@@ -935,7 +1019,8 @@ def run_lint(paths=None, root=None, rules=None, hot_entries=None,
         except OSError as e:
             raise ValueError(f"cannot read {ap}: {e}")
         nfiles += 1
-        fl = FileLint(ap, rel, text, env_registry, entries, active)
+        fl = FileLint(ap, rel, text, env_registry, entries, active,
+                      jax_free_entries=jax_free)
         findings.extend(fl.run())
         suppressed += fl.suppressed
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -305,6 +305,95 @@ def _event_gaps(ranks: Dict[int, List[dict]], gap_sec: float) -> List[dict]:
     return rows
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (stdlib-only, the
+    same estimator telemetry.py uses for its rolling rollups)."""
+    if not sorted_vals:
+        return 0.0
+    import math
+
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(math.ceil(q / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+# cap on per-request rows carried in the report object: a million-request
+# serving log must not turn --json into a gigabyte; the aggregate
+# percentiles cover the full population either way (log()-style note in
+# the section itself records the truncation)
+MAX_REQUEST_ROWS = 200
+
+
+def _serving_section(ranks: Dict[int, List[dict]]) -> Optional[dict]:
+    """Per-request serving breakdown from ``serve_request`` /
+    ``serve_preempt`` / ``serve_slo_violation`` events plus the
+    ``serve_stream`` spans' occupancy gauges (docs/OBSERVABILITY.md
+    §Serving traces).  None when the gang never served."""
+    requests: List[dict] = []
+    preempts: Dict[str, int] = {}
+    slo: Dict[str, int] = {"ttft": 0, "tpot": 0}
+    occupancy: List[dict] = []
+    for rank, events in sorted(ranks.items()):
+        for e in events:
+            kind = e.get("kind")
+            if kind == "serve_request":
+                requests.append({
+                    "rank": rank,
+                    "id": str(e.get("request_id", "?")),
+                    "queue_ms": round(float(e.get("queue_wait_ms", 0.0)), 3),
+                    "prefill_ms": round(float(e.get("prefill_ms", 0.0)), 3),
+                    "decode_ms": round(float(e.get("decode_ms", 0.0)), 3),
+                    "latency_ms": round(float(e.get("latency_ms", 0.0)), 3),
+                    "ttft_ms": round(float(e.get("ttft_ms", 0.0)), 3),
+                    "tokens": int(e.get("tokens", 0)),
+                    "reason": e.get("reason"),
+                })
+            elif kind == "serve_preempt":
+                rid = str(e.get("request_id", "?"))
+                preempts[rid] = preempts.get(rid, 0) + 1
+            elif kind == "serve_slo_violation":
+                stage = str(e.get("stage", "?"))
+                slo[stage] = slo.get(stage, 0) + 1
+            elif kind == "span" and e.get("name") == "serve_stream":
+                occupancy.append({
+                    "t": round(float(e.get("t", 0.0)), 3),
+                    "rank": rank,
+                    "active_slots": int(e.get("active_slots", 0)),
+                    "queue_depth": int(e.get("queue_depth", 0)),
+                })
+    if not requests and not occupancy and not preempts:
+        return None
+    ttfts = sorted(r["ttft_ms"] for r in requests if r["ttft_ms"] > 0)
+    lats = sorted(r["latency_ms"] for r in requests)
+    occupancy.sort(key=lambda row: row["t"])
+    slots = [row["active_slots"] for row in occupancy]
+    out = {
+        "requests": len(requests),
+        "tokens": sum(r["tokens"] for r in requests),
+        "ttft_p50_ms": round(_percentile(ttfts, 50), 3),
+        "ttft_p99_ms": round(_percentile(ttfts, 99), 3),
+        "latency_p50_ms": round(_percentile(lats, 50), 3),
+        "latency_p99_ms": round(_percentile(lats, 99), 3),
+        "preemptions": sum(preempts.values()),
+        "preempted_requests": preempts,
+        "slo_violations": slo,
+        "per_request": requests[:MAX_REQUEST_ROWS],
+        "per_request_truncated": max(0, len(requests) - MAX_REQUEST_ROWS),
+        "slot_occupancy": {
+            "samples": len(occupancy),
+            "mean_active_slots": (round(sum(slots) / len(slots), 3)
+                                  if slots else 0.0),
+            "max_active_slots": max(slots) if slots else 0,
+            "max_queue_depth": max((row["queue_depth"]
+                                    for row in occupancy), default=0),
+            # burst-cadence timeline (newest MAX_REQUEST_ROWS points):
+            # active slots + queue depth per stream boundary
+            "timeline": occupancy[-MAX_REQUEST_ROWS:],
+        },
+    }
+    return out
+
+
 def _find_stragglers(per_rank: Dict[int, dict], pct: float) -> List[dict]:
     flagged: List[dict] = []
     if len(per_rank) < 2:
@@ -421,6 +510,7 @@ def build_report(directory: str, window: Optional[int] = None,
         "compile_ms_total": round(sum(s["compile_ms"]
                                       for s in per_rank.values()), 3),
         "collectives": _collective_table(ranks),
+        "serving": _serving_section(ranks),
         "retraces": retraces,
         "resizes": resizes,
         "event_gaps": gaps,
@@ -487,6 +577,38 @@ def format_text(rep: dict) -> str:
             w(f"  {row['rank']:>4} {row['op']:<20} {row['count']:>5} "
               f"{_fmt_bytes(row['bytes']):>10} {row['wall_ms']:>10.1f} "
               f"{row['mb_per_sec']:>9.1f}")
+        w("")
+    srv = rep.get("serving")
+    if srv:
+        w("serving")
+        w(f"  {srv['requests']} request(s), {srv['tokens']} token(s); "
+          f"TTFT p50 {srv['ttft_p50_ms']:.1f}ms p99 "
+          f"{srv['ttft_p99_ms']:.1f}ms; latency p50 "
+          f"{srv['latency_p50_ms']:.1f}ms p99 "
+          f"{srv['latency_p99_ms']:.1f}ms")
+        occ = srv["slot_occupancy"]
+        w(f"  slot occupancy: mean {occ['mean_active_slots']:.2f} / max "
+          f"{occ['max_active_slots']} active over {occ['samples']} stream "
+          f"boundaries; max queue depth {occ['max_queue_depth']}")
+        if srv["preemptions"]:
+            w(f"  {srv['preemptions']} preemption(s): " + ", ".join(
+                f"{rid} x{n}" for rid, n in
+                sorted(srv["preempted_requests"].items())))
+        viol = {k: v for k, v in srv["slo_violations"].items() if v}
+        if viol:
+            w("  SLO violations: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(viol.items())))
+        w(f"  {'id':<12} {'queue ms':>9} {'prefill ms':>11} "
+          f"{'decode ms':>10} {'ttft ms':>8} {'tok':>4} reason")
+        for r in srv["per_request"][:20]:
+            w(f"  {r['id']:<12} {r['queue_ms']:>9.1f} "
+              f"{r['prefill_ms']:>11.1f} {r['decode_ms']:>10.1f} "
+              f"{r['ttft_ms']:>8.1f} {r['tokens']:>4} {r['reason']}")
+        if len(srv["per_request"]) > 20 or srv["per_request_truncated"]:
+            hidden = (len(srv["per_request"]) - 20
+                      + srv["per_request_truncated"])
+            w(f"  ... {hidden} more request(s) (--json carries "
+              f"{MAX_REQUEST_ROWS})")
         w("")
     if rep["retraces"]:
         w("retrace attribution")
