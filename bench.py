@@ -350,6 +350,23 @@ def main():
             result["serving_throughput"] = srv
             print(json.dumps(result), flush=True)
 
+    # plan_choice: the analytic auto-sharding planner's pick vs the worst
+    # legal plan of the same mesh, measured steps/sec on a 2-device toy
+    # net (docs/PERFORMANCE.md §Plan & planner).  Sanity floor: the
+    # chosen plan must not be SLOWER than the worst candidate; the
+    # planner's predicted ranking rides in the record so later eras can
+    # compare predicted ordering against measured walls.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_PLAN", "1") != "0"
+            and "error" not in result):
+        pc = _run_child("cpu", float(os.environ.get(
+            "BENCH_PLAN_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "plan_choice"})
+        if pc is not None:
+            pc.pop("probe_history", None)
+            result["plan_choice"] = pc
+            print(json.dumps(result), flush=True)
+
     # telemetry_overhead: steps/sec with the recorder + span tracing ON vs
     # fully off — the "observability must be cheap enough to leave on"
     # claim (docs/OBSERVABILITY.md §Tracing) measured, not asserted.
@@ -932,6 +949,115 @@ def bench_serving_throughput(platform):
     }))
 
 
+def bench_plan_choice(platform):
+    """Secondary metric: the auto-sharding planner's chosen plan vs the
+    WORST legal plan of the same 2-device mesh, measured steps/sec
+    through compile_step_with_plan on a toy Dense net with a
+    tp-shardable weight (the signature has no sequence dim, so the
+    legal candidates are dp2 and tp2 — and the ranking between them is
+    non-trivial: see below).  Interleaved chunks compared by
+    interquartile mean — the telemetry_overhead estimator; this box
+    drifts 2x at sub-second scale.  The sanity floor is value >= 1.0
+    (the chosen plan at least matches the worst candidate); the
+    planner's full predicted ranking lands in the record so later eras
+    can train on predicted-vs-measured (docs/PERFORMANCE.md §Plan &
+    planner)."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    import jax
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import compile_step_with_plan, local_mesh
+    from mxnet_tpu.parallel import planner
+    from mxnet_tpu.parallel.sharding import ShardingRules
+
+    B = int(os.environ.get("BENCH_PLAN_BATCH", 128))
+    D = int(os.environ.get("BENCH_PLAN_DIM", 2048))
+    H = int(os.environ.get("BENCH_PLAN_HIDDEN", 1024))
+    steps = int(os.environ.get("BENCH_PLAN_STEPS", 8))
+    trials = int(os.environ.get("BENCH_PLAN_TRIALS", 16))
+
+    devices = jax.devices()[:2]
+    if len(devices) < 2:
+        print(json.dumps({"metric": "plan_choice", "value": 0.0,
+                          "error": "needs 2 devices"}))
+        return
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(B, D).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, B).astype(np.float32))
+
+    rules = ShardingRules([(r".*dense0_weight", (None, "tp")),
+                           (r".*dense1_weight", ("tp", None))])
+
+    def build(plan):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(H, activation="relu", in_units=D),
+                    nn.Dense(10, in_units=H))
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        mesh = plan.build_mesh(devices)
+        return compile_step_with_plan(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), plan, mesh=mesh,
+            optimizer="sgd", optimizer_params={"learning_rate": 1e-3})
+
+    # hand-derived signature (a Dense feature dim is NOT a sequence —
+    # batch_shape is the batch dim only): grads (P ~ D*H*4 bytes) far
+    # outweigh activations (B*(H+10)*4), so the analytic model ranks tp
+    # (small activation collectives) ABOVE dp (full param-grad
+    # allreduce) — the non-obvious layout, and measurably the faster
+    # one on this box
+    sig = planner.ModelSignature(
+        param_shapes={"dense0_weight": (D, H), "dense0_bias": (H,),
+                      "dense1_weight": (H, 10), "dense1_bias": (10,)},
+        batch_shape=(B,), rules=rules,
+        flops_per_step=6.0 * B * (D * H + H * 10),
+        act_bytes=4.0 * B * (H + 10))
+    ranked = planner.enumerate_plans(sig, 2)
+    chosen_c, worst_c = ranked[0], ranked[-1]
+    steps_chosen = build(chosen_c.plan)
+    steps_worst = build(worst_c.plan)
+
+    def one_chunk(step):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = step.step(x, y)
+        step.drain()
+        float(loss)
+        return time.perf_counter() - t0
+
+    one_chunk(steps_chosen)   # compile warmup
+    one_chunk(steps_worst)
+    chosen_ts, worst_ts = [], []
+    for _ in range(trials):
+        chosen_ts.append(one_chunk(steps_chosen))
+        worst_ts.append(one_chunk(steps_worst))
+    chosen_sps = steps / _iq_mean(chosen_ts)
+    worst_sps = steps / _iq_mean(worst_ts)
+    print(json.dumps({
+        "metric": "plan_choice",
+        "value": round(chosen_sps / worst_sps, 3) if worst_sps else 0.0,
+        "unit": "x_chosen_vs_worst_legal_steps_per_sec",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "chosen_strategy": chosen_c.plan.strategy,
+        "worst_strategy": worst_c.plan.strategy,
+        "chosen_steps_per_sec": round(chosen_sps, 2),
+        "worst_steps_per_sec": round(worst_sps, 2),
+        "predicted_ranking": [
+            {"strategy": c.plan.strategy,
+             "mesh": {n: s for n, s in c.plan.mesh_axes if s > 1},
+             "predicted_step_s": round(float(c.step_s), 9)}
+            for c in ranked],
+        "batch": B, "dim": D, "hidden": H, "steps": steps,
+        "trials": trials,
+    }))
+
+
 def bench_telemetry_overhead(platform):
     """Secondary metric: steady-state steps/sec with the telemetry
     recorder + span tracing enabled (MX_TELEMETRY_DIR set, spans on — the
@@ -1284,6 +1410,8 @@ def child_main(platform):
         bench_pipeline_overlap(platform)
     elif model == "serving_throughput":
         bench_serving_throughput(platform)
+    elif model == "plan_choice":
+        bench_plan_choice(platform)
     elif model == "telemetry_overhead":
         bench_telemetry_overhead(platform)
     elif model == "memwatch_overhead":

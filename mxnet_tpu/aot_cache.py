@@ -152,6 +152,11 @@ def load(key: str):
         info["cache_hit"] = True
         info["deserialize_ms"] = round(
             (time.perf_counter() - t0) * 1e3, 3)
+        # caller-supplied meta rides back out: sites whose executables
+        # need structural facts the python fn only yields at trace time
+        # (CachedOp's output treedef/aux binding) restore them from here
+        # instead of paying the trace a cache hit exists to skip
+        info["meta"] = rec.get("meta") or {}
         return loaded, info
     except Exception as e:
         # torn write, partial disk, version drift, pickle garbage: all
@@ -163,7 +168,8 @@ def load(key: str):
 
 
 def get_or_compile(jitted, args, fingerprint: str, platform: str,
-                   mesh_shape: Tuple = (), device_ids: Tuple = ()):
+                   mesh_shape: Tuple = (), device_ids: Tuple = (),
+                   meta_fn=None):
     """The jit-site entry point: resolve ``fingerprint`` to a compiled
     executable — deserialized from the persistent cache when warm, else
     compiled ahead-of-time (``jitted.lower(*args).compile()``) and
@@ -173,21 +179,34 @@ def get_or_compile(jitted, args, fingerprint: str, platform: str,
 
     ``info`` feeds the compile telemetry event: ``cache_hit`` +
     ``deserialize_ms`` on a warm load, ``cache_hit=False`` (+ optional
-    ``cache_corrupt``) after a fresh AOT compile."""
+    ``cache_corrupt``) after a fresh AOT compile.
+
+    ``meta_fn`` (optional, zero-arg) supplies extra entry metadata and
+    is called AFTER the fresh compile — i.e. after ``jitted`` traced,
+    so structural facts the trace produces as side effects can be
+    captured; on a warm load the stored metadata returns in
+    ``info['meta']`` instead."""
     if not enabled():
         return None, {}
     try:
         key = cache_key(fingerprint, platform, mesh_shape, device_ids)
         compiled, info = load(key)
+        if meta_fn is None:
+            # only sites that persist structural meta consume it; the
+            # others forward info verbatim into compile telemetry
+            # events, which must not grow a redundant meta blob
+            info.pop("meta", None)
         if compiled is not None:
             return compiled, info
         t0 = time.perf_counter()
         compiled = jitted.lower(*args).compile()
         info["cache_hit"] = False
         info["aot_compile_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
-        store(key, compiled, meta={"fingerprint": fingerprint,
-                                   "platform": platform,
-                                   "mesh_shape": tuple(mesh_shape)})
+        meta = {"fingerprint": fingerprint, "platform": platform,
+                "mesh_shape": tuple(mesh_shape)}
+        if meta_fn is not None:
+            meta.update(meta_fn() or {})
+        store(key, compiled, meta=meta)
         return compiled, info
     except Exception as e:
         _LOG.warning("aot_cache: AOT compile/load failed for %s (%s); "

@@ -253,6 +253,15 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     "MX_TRACE_HEARTBEAT_GAP_SEC": (
         "honored", "trace_report.py flags stretches where a rank's event "
         "stream went silent longer than this many seconds (default 30)"),
+    # unified parallelism Plan + analytic auto-sharding planner
+    # (docs/PERFORMANCE.md §Plan & planner)
+    "MX_PLAN": (
+        "honored", "parallelism-layout override for the analytic "
+        "planner: 'auto' (default) picks the argmin of the cost model "
+        "over every legal dp*tp*pp*sp factorization; 'dp'/'tp'/'pp'/"
+        "'sp' pin the corresponding axis family; 'ring'/'ulysses' "
+        "additionally select the SP attention mechanism "
+        "(parallel/planner.py plan_for)"),
     # memory & compile observability (docs/OBSERVABILITY.md §Memory)
     "MX_MEMWATCH": (
         "honored", "device-memory watchdog riding the telemetry "

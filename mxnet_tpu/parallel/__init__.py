@@ -10,10 +10,14 @@ from .mesh import (make_mesh, local_mesh, device_mesh, host_barrier,
                    global_allreduce)
 from .async_loss import (AsyncLoss, InflightRing, StackedAsyncLoss,
                          SuperstepLossView, drain_all, inflight_limit)
-from .data_parallel import DataParallelStep, make_train_step, superstep_k
+from .data_parallel import (DataParallelStep, compile_step_with_plan,
+                            make_train_step, superstep_k)
+from .plan import (Plan, dp_plan, tensor_parallel_plan, pipeline_plan,
+                   ring_plan, ulysses_plan)
 from .ring import ring_attention, ring_self_attention
 from .ulysses import ulysses_self_attention
 from .pipeline import pipeline_apply
 from .scope import ring_attention_scope, ring_scope, ring_scope_mesh
 from . import dist
+from . import planner
 from . import sharding

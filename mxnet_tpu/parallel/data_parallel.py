@@ -29,10 +29,11 @@ from .. import telemetry
 from ..base import MXNetError
 from .async_loss import (AsyncLoss, InflightRing, StackedAsyncLoss,
                          SuperstepLossView, inflight_limit)
+from .plan import Plan, dp_plan
 from .sharding import ShardingRules, replicated, shard_batch
 
-__all__ = ["DataParallelStep", "make_train_step", "superstep_k",
-           "flush_all_steps"]
+__all__ = ["DataParallelStep", "make_train_step", "compile_step_with_plan",
+           "superstep_k", "flush_all_steps", "dp_plan"]
 
 # every live step object in the process, so preemption paths can flush
 # buffered-but-undispatched superstep groups they never saw (weak: the
@@ -247,7 +248,8 @@ class DataParallelStep:
                  donate: bool = True, remat: bool = False,
                  ring_attention: bool = False, accum_steps: int = 1,
                  clip_global_norm: Optional[float] = None,
-                 pp_microbatches: int = 4):
+                 pp_microbatches: int = 4,
+                 plan: Optional[Plan] = None):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
@@ -290,24 +292,71 @@ class DataParallelStep:
         microbatch's), gradients average, then ONE optimizer update.
         Statically unrolled in the XLA program; combine with remat=True
         for maximum effective batch per chip (reference analog:
-        grad_req='add' + delayed Trainer.step)."""
+        grad_req='add' + delayed Trainer.step).
+
+        plan: a :class:`~mxnet_tpu.parallel.plan.Plan` carrying ALL of
+        the strategy knobs above (rules/batch_axes/seq_axis/
+        ring_attention/accum_steps/pp_microbatches) as one value — the
+        unified path ``compile_step_with_plan`` uses; the individual
+        kwargs then must stay at their defaults.  Without a plan, this
+        constructor is itself the dp-era compat shim: it builds the
+        equivalent Plan from its kwargs, so every step — legacy or
+        plan-built — flows through the same plan-driven dispatch."""
         import jax
 
         from ..context import current_context
 
-        if mesh is None:
-            from .mesh import local_mesh
+        if plan is not None:
+            clash = [kw for kw, val, dflt in (
+                ("rules", rules, None),
+                ("batch_axes", tuple(batch_axes), ("dp", "sp")),
+                ("seq_axis", seq_axis, None),
+                ("ring_attention", ring_attention, False),
+                ("accum_steps", accum_steps, 1),
+                ("pp_microbatches", pp_microbatches, 4),
+            ) if val != dflt]
+            if clash:
+                raise MXNetError(
+                    f"DataParallelStep: both plan= and strategy kwargs "
+                    f"{clash} given — the Plan already carries them")
+            if mesh is None:
+                mesh = plan.build_mesh()
+            elif not plan.matches_mesh(mesh):
+                raise MXNetError(
+                    f"Plan axes {dict(plan.mesh_axes)} do not match the "
+                    f"given mesh {dict(mesh.shape)}")
+        else:
+            if mesh is None:
+                from .mesh import local_mesh
 
-            mesh = local_mesh()
+                mesh = local_mesh()
+            if ring_attention not in (True, False, "ring", "ulysses"):
+                raise MXNetError("ring_attention must be bool, 'ring' or "
+                                 f"'ulysses', got {ring_attention!r}")
+            sp_mode = ("gspmd" if ring_attention is False
+                       else "ring" if ring_attention is True
+                       else ring_attention)
+            if sp_mode != "gspmd" and dict(mesh.shape).get("sp", 1) < 2 \
+                    and seq_axis != 1:
+                # legacy tolerance: ring_attention on a mesh with no sp
+                # axis was inert (the scope only activates with a
+                # sequence-sharded input) — keep it inert, not an error
+                sp_mode = "gspmd"
+            plan = Plan(
+                mesh_axes=tuple(mesh.shape.items()),
+                rules=rules or ShardingRules(),
+                # shard_batch ignores absent axes; the Plan is strict
+                # about naming only real ones
+                batch_axes=tuple(a for a in batch_axes
+                                 if a in mesh.axis_names),
+                seq_axis=seq_axis,
+                sp_attention=sp_mode,
+                pp_microbatches=int(pp_microbatches),
+                accum_steps=int(accum_steps))
+        self.plan = plan
         self.mesh = mesh
         self.block = block
         self.loss_fn = loss_fn
-        self.rules = rules or ShardingRules()
-        self._batch_axes = tuple(batch_axes)
-        if seq_axis not in (None, 1, -1):
-            raise MXNetError("seq_axis must be None (auto), 1 (force SP on "
-                             "dim 1) or -1 (disable SP)")
-        self._seq_axis = seq_axis
         opt_params = dict(optimizer_params or {})
         self._lr = opt_params.get("learning_rate", 0.01)
         # lr is a DEVICE SCALAR ARGUMENT of the compiled step (not a trace
@@ -326,17 +375,6 @@ class DataParallelStep:
         self._optimizer = optimizer
         self._donate = donate
         self._remat = remat
-        if ring_attention not in (True, False, "ring", "ulysses"):
-            raise MXNetError("ring_attention must be bool, 'ring' or "
-                             f"'ulysses', got {ring_attention!r}")
-        self._ring = ring_attention
-        if accum_steps < 1:
-            raise MXNetError(f"accum_steps must be >= 1, got {accum_steps}")
-        self._accum = int(accum_steps)
-        if pp_microbatches < 1:
-            raise MXNetError(
-                f"pp_microbatches must be >= 1, got {pp_microbatches}")
-        self._pp_micro = int(pp_microbatches)
 
         ctx = current_context()
         self._ctx = ctx
@@ -417,7 +455,7 @@ class DataParallelStep:
                     self.block(*example_inputs)
             names = [n for n, _ in self._param_items]
             shapes = {n: tuple(p.data().shape) for n, p in self._param_items}
-            self._shardings = self.rules.shardings(self.mesh, shapes)
+            self._shardings = self.plan.rules.shardings(self.mesh, shapes)
             params = {
                 n: _global_put(p.data()._data, self._shardings[n])
                 for n, p in self._param_items
@@ -482,7 +520,7 @@ class DataParallelStep:
             larr = loss._data if isinstance(loss, NDArray) else loss
             return jnp.mean(larr.astype(jnp.float32)), aux
 
-        accum = self._accum
+        accum = self.plan.accum_steps
 
         def step(params, opt_state, key, lr, data, label):
             if accum == 1:
@@ -568,14 +606,14 @@ class DataParallelStep:
         sp_active = (
             "sp" in self.mesh.axis_names
             and self.mesh.shape["sp"] > 1
-            and "sp" in self._batch_axes
-            and self._seq_axis != -1
+            and "sp" in self.plan.batch_axes
+            and self.plan.seq_axis != -1
             and any(np.ndim(a) >= 2 for a in data_arrs)
         )
-        if sp_active and self._seq_axis is None:
+        if sp_active and self.plan.seq_axis is None:
             sp_active = all(np.shape(a)[1] % self.mesh.shape["sp"] == 0
                             for a in data_arrs if np.ndim(a) >= 2)
-        if self._seq_axis == 1 and sp_active:
+        if self.plan.seq_axis == 1 and sp_active:
             # explicit SP opt-in: a non-divisible seq dim is a caller error,
             # not something to silently decline (the ring scope and the
             # shard specs must agree on what was sequence-sharded)
@@ -595,7 +633,7 @@ class DataParallelStep:
                 return shard_batch_seq(self.mesh, np.ndim(arr))
             if sp_active:  # rank-1 (or ragged) input under SP: dp only
                 return shard_batch(self.mesh, ("dp",), np.ndim(arr))
-            return shard_batch(self.mesh, self._batch_axes, np.ndim(arr))
+            return shard_batch(self.mesh, self.plan.batch_axes, np.ndim(arr))
 
         return (tuple(_shard_one(a) for a in data_arrs),
                 _shard_one(label_arr), sp_active)
@@ -712,14 +750,14 @@ class DataParallelStep:
             traced = telemetry.note_signature(name, sig)
         else:  # detection off: still split the first-call compile out
             traced = self._jitted is None
-        if self._accum > 1:
+        if self.plan.accum_steps > 1:
             label_dim0 = (label.shape[0] if hasattr(label, "shape") else
                           np.shape(label)[0])
             for dim0 in [d.shape[0] for d in datas] + [label_dim0]:
-                if dim0 % self._accum:
+                if dim0 % self.plan.accum_steps:
                     raise MXNetError(
                         f"batch {dim0} not divisible by "
-                        f"accum_steps={self._accum}")
+                        f"accum_steps={self.plan.accum_steps}")
         self._ensure_state(datas)
         if self._jitted is None:
             self._build()
@@ -758,49 +796,17 @@ class DataParallelStep:
             if pre:
                 overlapped += int(getattr(label_arr, "nbytes", 0))
         key = _random.next_key()
-        # Pallas kernels must lower for the platform the MESH runs on (a CPU
-        # mesh under a TPU default backend needs interpret mode); the flag is
-        # baked in at trace time, so scope the override around the jit call.
-        from ..ops import pallas as _pk
-
-        from .. import profiler
-
-        ring_cm, pp_cm = self._dispatch_scopes(sp_active)
-        mesh_platform = next(iter(self.mesh.devices.flat)).platform
         lr_val = np.float32(self._current_lr(self._step_count + 1))
         with telemetry.span("dispatch", step=self._step_count + 1,
                             traced=traced):
-            try:
-                # chaos harness: `oom:step=N` raises a synthetic
-                # RESOURCE_EXHAUSTED here, exercising the same post-mortem
-                # path a real HBM exhaustion takes
-                fault.on_dispatch(self._step_count + 1)
-                with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
-                    call_args = (self.params, self.opt_state, key, lr_val,
-                                 data_arrs, label_arr)
-                    run = self._jitted
-                    if aot_on:
-                        # persistent AOT executable (inside the scopes —
-                        # a cache MISS lowers the step fn here, and the
-                        # scope flags are trace-time facts)
-                        aot = self._resolve_aot(sig, call_args,
-                                                mesh_platform)
-                        if aot is not None:
-                            run = aot
-                    if profiler.is_recording():
-                        base_run = run
-                        run = (lambda *a: profiler.timed_call(
-                            f"FusedStep:{type(self.block).__name__}",
-                            base_run, *a))
-                    self.params, self.opt_state, loss = run(*call_args)
-            except Exception as e:
-                if memwatch.is_resource_exhausted(e):
-                    # land the post-mortem (census, largest category, top
-                    # executables, window depth) on disk before dying
-                    memwatch.emit_oom_report(
-                        executor=name, step=self._step_count + 1,
-                        inflight_depth=self._inflight.depth)
-                raise
+            call_args = (self.params, self.opt_state, key, lr_val,
+                         data_arrs, label_arr)
+            resolve = ((lambda a, p: self._resolve_aot(sig, a, p))
+                       if aot_on else None)
+            self.params, self.opt_state, loss = self._plan_dispatch(
+                self._jitted, call_args, (self._step_count + 1,),
+                sp_active, resolve,
+                f"FusedStep:{type(self.block).__name__}")
         if traced and telemetry.enabled():
             # what step() needs to book the compile once the hot body is
             # done: structural fingerprint parts + arg shape mirrors
@@ -874,13 +880,63 @@ class DataParallelStep:
         hyper_sig = (self._momentum, self._wd, self._rescale,
                      self._beta1, self._beta2, self._eps,
                      self._clip_gradient, self._clip_global,
-                     self._remat, self._ring, self._pp_micro,
+                     self._remat, self.plan.sp_attention,
+                     self.plan.pp_microbatches,
+                     self.plan.batch_axes, self.plan.seq_axis,
                      type(self.loss_fn).__name__,
                      tuple(sorted(self._mults.items())))
         return (("DataParallelStep",) + tuple(variant)
                 + (type(self.block).__name__,
-                   self._optimizer, self._accum, hyper_sig,
+                   self._optimizer, self.plan.accum_steps, hyper_sig,
                    tuple(self.mesh.shape.items()), shape_sig))
+
+    def _plan_dispatch(self, fn, call_args, step_nos, sp_active,
+                       resolve_aot, profile_label):
+        """THE unified dispatch body: every compiled-step execution —
+        single step or superstep scan, whatever strategy the Plan
+        encodes (dp/tp/pp/ring/ulysses and their compositions) — runs
+        through here.  Per covered step the chaos/fault hook fires
+        (`oom:step=N` raises a synthetic RESOURCE_EXHAUSTED exactly
+        where a real HBM exhaustion would); the plan's trace-time
+        scopes activate (pallas platform override, ring/ulysses SP
+        routing, pipeline microbatch schedule); ``resolve_aot`` swaps
+        in the persistent AOT executable when warm (INSIDE the scopes —
+        a cache MISS lowers the program here, and the scope flags are
+        trace-time facts); the profiler wrap and the OOM post-mortem
+        close the loop.  ``step_nos`` are the logical step numbers the
+        dispatch covers (one for a single step, K for a superstep)."""
+        from ..ops import pallas as _pk
+
+        from .. import profiler
+
+        # Pallas kernels must lower for the platform the MESH runs on
+        # (a CPU mesh under a TPU default backend needs interpret
+        # mode); the flag is baked in at trace time, so scope the
+        # override around the jit call.
+        ring_cm, pp_cm = self._dispatch_scopes(sp_active)
+        mesh_platform = next(iter(self.mesh.devices.flat)).platform
+        try:
+            for s in step_nos:
+                fault.on_dispatch(s)
+            with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
+                run = fn
+                if resolve_aot is not None:
+                    aot = resolve_aot(call_args, mesh_platform)
+                    if aot is not None:
+                        run = aot
+                if profiler.is_recording():
+                    base_run = run
+                    run = (lambda *a: profiler.timed_call(
+                        profile_label, base_run, *a))
+                return run(*call_args)
+        except Exception as e:
+            if memwatch.is_resource_exhausted(e):
+                # land the post-mortem (census, largest category, top
+                # executables, window depth) on disk before dying
+                memwatch.emit_oom_report(
+                    executor=self._tele_name, step=step_nos[-1],
+                    inflight_depth=self._inflight.depth)
+            raise
 
     def _dispatch_scopes(self, sp_active):
         """(ring_cm, pp_cm) trace-time scopes for one dispatch — shared
@@ -895,13 +951,13 @@ class DataParallelStep:
         # batch-dim axes travel with the scope so the ring's shard_map
         # spec matches the activations' real sharding (dp batch + tp
         # heads on the collapsed B*H dim)
-        if self._ring and sp_active:
+        if self.plan.sp_attention != "gspmd" and sp_active:
             dim0_axes = tuple(
-                a for a in (tuple(x for x in self._batch_axes if x != "sp")
+                a for a in (tuple(x for x in self.plan.batch_axes if x != "sp")
                             + ("tp",))
                 if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
-            mode = self._ring if isinstance(self._ring, str) else "ring"
-            ring_cm = ring_attention_scope(self.mesh, dim0_axes, mode=mode)
+            ring_cm = ring_attention_scope(self.mesh, dim0_axes,
+                                           mode=self.plan.sp_attention)
         else:
             ring_cm = contextlib.nullcontext()
         # pipeline scope: stacked-encoder models route their layer stack
@@ -910,11 +966,11 @@ class DataParallelStep:
                 and not sp_active):
             from .scope import pipeline_parallel_scope
 
-            pp_axes = tuple(a for a in self._batch_axes
+            pp_axes = tuple(a for a in self.plan.batch_axes
                             if a != "sp" and a in self.mesh.axis_names
                             and self.mesh.shape[a] > 1)
             pp_cm = pipeline_parallel_scope(self.mesh, pp_axes,
-                                            self._pp_micro)
+                                            self.plan.pp_microbatches)
         else:
             pp_cm = contextlib.nullcontext()
         return ring_cm, pp_cm
@@ -1003,13 +1059,13 @@ class DataParallelStep:
             # lengths): close the open group as a shorter scan — one
             # stacked group must be shape-uniform
             self._dispatch_group(self._open_group)
-        if self._accum > 1:
+        if self.plan.accum_steps > 1:
             for dim0 in [np.shape(a)[0] for a in data_arrs] + \
                     [np.shape(label_arr)[0]]:
-                if dim0 % self._accum:
+                if dim0 % self.plan.accum_steps:
                     raise MXNetError(
                         f"batch {dim0} not divisible by "
-                        f"accum_steps={self._accum}")
+                        f"accum_steps={self.plan.accum_steps}")
         data_sh, label_sh, _sp = self._input_shardings(data_arrs, label_arr)
         overlapped = 0
         placed = []
@@ -1092,42 +1148,18 @@ class DataParallelStep:
             # steps inside the compiled program exactly as it would
             # under sequential dispatch
             lrs = np.array([e["lr"] for e in entries], np.float32)
-        from ..ops import pallas as _pk
-
-        from .. import profiler
-
-        ring_cm, pp_cm = self._dispatch_scopes(sp_active)
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
         with telemetry.span("dispatch", step=last_step, traced=traced,
                             superstep=k):
-            try:
-                # chaos harness: every step the group covers gets its
-                # dispatch hook — `oom:step=N` for a mid-group N raises
-                # at the group dispatch, where the program really runs
-                for e in entries:
-                    fault.on_dispatch(e["step"])
-                with _pk.compute_on(mesh_platform), ring_cm, pp_cm:
-                    fn = self._super_fn(k, mesh_platform)
-                    call_args = (self.params, self.opt_state, keys, lrs,
-                                 datas, label_arr)
-                    run = fn
-                    if aot_on:
-                        aot = self._resolve_super_aot(sig, fn, call_args,
-                                                      mesh_platform)
-                        if aot is not None:
-                            run = aot
-                    if profiler.is_recording():
-                        base_run = run
-                        run = (lambda *a: profiler.timed_call(
-                            f"Superstep:{type(self.block).__name__}",
-                            base_run, *a))
-                    self.params, self.opt_state, losses = run(*call_args)
-            except Exception as e:
-                if memwatch.is_resource_exhausted(e):
-                    memwatch.emit_oom_report(
-                        executor=name, step=last_step,
-                        inflight_depth=self._inflight.depth)
-                raise
+            fn = self._super_fn(k, mesh_platform)
+            call_args = (self.params, self.opt_state, keys, lrs,
+                         datas, label_arr)
+            resolve = ((lambda a, p: self._resolve_super_aot(sig, fn, a, p))
+                       if aot_on else None)
+            self.params, self.opt_state, losses = self._plan_dispatch(
+                fn, call_args, tuple(e["step"] for e in entries),
+                sp_active, resolve,
+                f"Superstep:{type(self.block).__name__}")
         if traced and telemetry.enabled():
             cache_info = self._last_cache_info
             self._last_cache_info = {}
@@ -1334,6 +1366,11 @@ class DataParallelStep:
             "device_ids": [int(d.id) for d in self.mesh.devices.flat],
             "platform": next(iter(self.mesh.devices.flat)).platform,
             "specs": specs,
+            # the full strategy Plan rides with the placement: an elastic
+            # restore knows WHICH strategy produced these specs, and
+            # Plan.from_json(layout["plan"]) rebuilds it on the new world
+            # (docs/FAULT_TOLERANCE.md §Elastic resize)
+            "plan": self.plan.to_json(),
         }
 
     def _to_host_full(self, arr, allow_collective: bool = True):
@@ -1455,7 +1492,7 @@ class DataParallelStep:
                 # state)
                 shapes = {local_of.get(sname, sname): tuple(np.shape(v))
                           for sname, v in params_host.items()}
-                self._shardings = self.rules.shardings(self.mesh, shapes)
+                self._shardings = self.plan.rules.shardings(self.mesh, shapes)
             cur = self.layout()
             same = (saved_layout is not None
                     and _layouts_equal(saved_layout, cur))
@@ -1552,3 +1589,34 @@ def _layouts_equal(a: dict, b: dict) -> bool:
 
 def make_train_step(block, loss_fn, mesh=None, **kwargs) -> DataParallelStep:
     return DataParallelStep(block, loss_fn, mesh=mesh, **kwargs)
+
+
+def compile_step_with_plan(block, loss_fn, plan: Plan, mesh=None,
+                           **kwargs) -> DataParallelStep:
+    """THE single compile path of the parallelism zoo: consume ANY
+    :class:`~mxnet_tpu.parallel.plan.Plan` — dp, tp, pipeline, ring or
+    Ulysses SP, or any composition the planner enumerated — and return
+    the compiled :class:`DataParallelStep` for it.  Superstep scan mode,
+    the persistent AOT executable cache, the async in-flight window,
+    telemetry spans and elastic resharding all ride along: they are
+    features of the one dispatch body (``_plan_dispatch``), not of any
+    single strategy.
+
+    ``mesh`` defaults to ``plan.build_mesh()`` over all devices; pass an
+    explicit mesh (it must match the plan's axes) to pin devices.
+    Remaining kwargs (optimizer/optimizer_params/donate/remat/
+    clip_global_norm) pass through — they are training-config, not
+    layout, so they live outside the Plan.
+
+    Records one ``plan`` telemetry event carrying the plan and, when the
+    planner chose it, the predicted cost breakdown —
+    ``tools/trace_report.py`` can then compare predicted step wall
+    against the measured ``step`` events of the same stream
+    (docs/PERFORMANCE.md §Plan & planner)."""
+    step = DataParallelStep(block, loss_fn, mesh=mesh, plan=plan, **kwargs)
+    if telemetry.enabled():
+        telemetry.record(
+            "plan", executor=step._tele_name, strategy=plan.strategy,
+            plan=plan.to_json(),
+            predicted=plan.predicted)
+    return step

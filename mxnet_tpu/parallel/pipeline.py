@@ -23,7 +23,19 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_plan"]
+
+
+def pipeline_plan(pp, microbatches=4, dp=0, n_devices=None, rules=None,
+                  accum_steps=1):
+    """Compat shim: the GPipe pipeline strategy as a
+    :class:`~mxnet_tpu.parallel.plan.Plan` — stacked-encoder models
+    route through :func:`pipeline_apply` when the compiled step
+    activates the pp scope (docs/PERFORMANCE.md §Plan & planner)."""
+    from .plan import pipeline_plan as _pp
+
+    return _pp(pp, microbatches=microbatches, dp=dp, n_devices=n_devices,
+               rules=rules, accum_steps=accum_steps)
 
 
 def pipeline_apply(mesh, fn: Callable, stacked_params, x_micro,

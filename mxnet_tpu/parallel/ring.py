@@ -24,7 +24,18 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "ring_plan"]
+
+
+def ring_plan(sp, dp=0, n_devices=None, rules=None, accum_steps=1):
+    """Compat shim: ring-attention sequence parallelism as a
+    :class:`~mxnet_tpu.parallel.plan.Plan` (docs/PERFORMANCE.md §Plan &
+    planner) — the compiled step lowers fused-attention ops to the
+    ppermute K/V rotation below."""
+    from .plan import ring_plan as _rp
+
+    return _rp(sp, dp=dp, n_devices=n_devices, rules=rules,
+               accum_steps=accum_steps)
 
 _NEG = -1e30
 

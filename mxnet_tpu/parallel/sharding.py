@@ -9,7 +9,8 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["ShardingRules", "replicated", "shard_batch", "shard_map_compat"]
+__all__ = ["ShardingRules", "replicated", "shard_batch", "shard_map_compat",
+           "tensor_parallel_plan"]
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs, **kwargs):
@@ -58,6 +59,31 @@ class ShardingRules:
     def __init__(self, rules: Optional[Sequence[Tuple[str, Sequence]]] = None):
         self._rules = [(re.compile(pat), tuple(spec)) for pat, spec in (rules or [])]
 
+    def to_json(self):
+        """Lossless [[pattern, [spec...]], ...] form — how a Plan carries
+        its per-param specs into the checkpoint ``layout`` block."""
+        return [[pat.pattern,
+                 [list(a) if isinstance(a, tuple) else a for a in spec]]
+                for pat, spec in self._rules]
+
+    @classmethod
+    def from_json(cls, rec) -> "ShardingRules":
+        return cls([(pat, tuple(tuple(a) if isinstance(a, list) else a
+                                for a in spec)) for pat, spec in (rec or [])])
+
+    def __eq__(self, other):
+        return (isinstance(other, ShardingRules)
+                and self.to_json() == other.to_json())
+
+    def __hash__(self):
+        # hash the same normalized form __eq__ compares (to_json turns
+        # tuple entries into lists, so equal-by-eq instances — and
+        # list-typed spec entries — hash consistently)
+        return hash(repr(self.to_json()))
+
+    def __bool__(self):
+        return bool(self._rules)
+
     def spec_for(self, name: str, ndim: int):
         for pat, spec in self._rules:
             if pat.match(name):
@@ -88,6 +114,17 @@ def shard_batch(mesh, axes=("dp",), ndim=2):
     axis = tuple(a for a in axes if a in mesh.axis_names)
     spec = (axis if len(axis) > 1 else (axis[0] if axis else None),)
     return NamedSharding(mesh, _P(*spec, *([None] * (ndim - 1))))
+
+
+def tensor_parallel_plan(rules, tp, dp=0, n_devices=None, accum_steps=1):
+    """Compat shim: the ShardingRules tensor-parallel strategy as a
+    :class:`~mxnet_tpu.parallel.plan.Plan` (docs/PERFORMANCE.md §Plan &
+    planner) — build the plan here, compile it through
+    ``data_parallel.compile_step_with_plan``."""
+    from .plan import tensor_parallel_plan as _tp
+
+    return _tp(rules, tp, dp=dp, n_devices=n_devices,
+               accum_steps=accum_steps)
 
 
 def shard_batch_seq(mesh, ndim=2):

@@ -23,7 +23,18 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["ulysses_self_attention"]
+__all__ = ["ulysses_self_attention", "ulysses_plan"]
+
+
+def ulysses_plan(sp, dp=0, n_devices=None, rules=None, accum_steps=1):
+    """Compat shim: Ulysses all-to-all sequence parallelism as a
+    :class:`~mxnet_tpu.parallel.plan.Plan` (docs/PERFORMANCE.md §Plan &
+    planner) — the compiled step reshards heads through the all-to-all
+    pair below."""
+    from .plan import ulysses_plan as _up
+
+    return _up(sp, dp=dp, n_devices=n_devices, rules=rules,
+               accum_steps=accum_steps)
 
 
 def _local_attn(q, k, v, causal, sm_scale):

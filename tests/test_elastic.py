@@ -644,6 +644,43 @@ def test_supervisor_regrows_to_target(tmp_path):
     assert "rank 2/3 prev 2" in res.stdout, res.stdout
 
 
+@pytest.mark.chaos
+def test_supervisor_regrow_steps_and_rearms(tmp_path):
+    """The PR 11 'Known' fix: regrow steps +1 toward the target (1 -> 2
+    -> 3, a fresh stability countdown at each size, NOT one jump to -n),
+    and re-arms after a LATER culprit shrinks the regrown gang below
+    target again — the grow -> shrink -> grow cycle converges back to
+    the target instead of sticking at the shrunken size."""
+    marker = tmp_path / "crashed.marker"
+    res = _run_elastic(tmp_path, (
+        "import os, sys, time\n"
+        "n = os.environ['MX_NUM_PROCS']\n"
+        f"marker = {str(marker)!r}\n"
+        "print(f\"rank {os.environ['MX_PROC_ID']}/{n} prev \"\n"
+        "      f\"{os.environ.get('MX_PREV_NUM_PROCS', '-')}\", flush=True)\n"
+        "if n == '3':\n"
+        "    if not os.path.exists(marker):\n"
+        "        # first time at target: rank 2's host goes bad once\n"
+        "        if os.environ['MX_PROC_ID'] == '2':\n"
+        "            open(marker, 'w').write('x')\n"
+        "            sys.exit(7)\n"
+        "        time.sleep(30)\n"
+        "    sys.exit(0)  # second regrow to target: healthy\n"
+        "time.sleep(60)  # below target: wait for the regrow preemption\n"
+    ), n=3, extra_args=("--max-restarts", "0", "--initial-workers", "1",
+                        "--regrow-after", "1", "--term-timeout", "2"),
+        timeout=120)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    # +1 stepping: two distinct growth steps on the way up
+    assert "growing gang 1 -> 2" in res.stderr, res.stderr
+    assert res.stderr.count("growing gang 2 -> 3") == 2, res.stderr
+    # never a straight 1 -> 3 jump
+    assert "growing gang 1 -> 3" not in res.stderr
+    assert "shrinking gang 3 -> 2" in res.stderr, res.stderr
+    # the re-regrown incarnation carries the resize export
+    assert "rank 2/3 prev 2" in res.stdout, res.stdout
+
+
 def test_cli_validates_elastic_flags():
     for args in (["--min-workers", "0"],
                  ["--min-workers", "5"],

@@ -909,6 +909,61 @@ def test_metrics_server_entries_registered():
 # ---------------------------------------------------------------------------
 # the tier-1 gate: the real tree is lint-clean, fast, at head
 # ---------------------------------------------------------------------------
+def test_plan_dispatch_entry_registered_and_rename_fails_loudly(tmp_path):
+    """The unified Plan dispatch body is in the REAL HOT_PATH_ENTRIES
+    (every strategy's every step funnels through it — a host sync there
+    stalls dp, tp, pp, ring and ulysses at once), and renaming it in a
+    fixture carrying the entry flags stale-hot-entry rather than
+    silently un-linting the path."""
+    real = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/parallel/data_parallel.py"]
+    assert "DataParallelStep._plan_dispatch" in real
+
+    entries = {"mxnet_tpu/fixture.py": ("DataParallelStep._plan_dispatch",)}
+    findings, _ = lint_src(tmp_path, """
+        class DataParallelStep:
+            def _plan_dispatch_renamed(self):
+                return None
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["stale-hot-entry"]
+    assert "DataParallelStep._plan_dispatch" in findings[0].message
+
+    # positive: a readback reachable from the dispatch body through a
+    # helper (e.g. forcing the loss before returning) is flagged
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class DataParallelStep:
+            def _plan_dispatch(self, fn, call_args):
+                out = fn(*call_args)
+                return self._force(out)
+
+            def _force(self, out):
+                return np.asarray(out)   # host sync in the hot funnel
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+    assert findings[0].context == "DataParallelStep._force"
+
+    # negative: the real body's shape — fault hooks, scopes, AOT swap,
+    # dispatch — carries no syncs
+    findings, _ = lint_src(tmp_path, """
+        class DataParallelStep:
+            def _plan_dispatch(self, fn, call_args, step_nos,
+                               resolve_aot):
+                for s in step_nos:
+                    self._on_dispatch(s)
+                run = fn
+                if resolve_aot is not None:
+                    aot = resolve_aot(call_args)
+                    if aot is not None:
+                        run = aot
+                return run(*call_args)
+
+            def _on_dispatch(self, s):
+                return s
+        """, hot_entries=entries)
+    assert findings == []
+
+
 def test_full_tree_is_clean_and_fast():
     t0 = time.perf_counter()
     findings, stats = mxlint.run_lint()   # mxnet_tpu tools examples

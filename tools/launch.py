@@ -877,13 +877,17 @@ def launch_local(num_workers: int, command, env_extra=None,
     world size is exported as ``MX_PREV_NUM_PROCS`` so workers know to
     rebuild their mesh/kvstore/step and reshard their checkpoint on
     restore.  ``initial_workers`` starts the gang below target (a fleet
-    that came up degraded), and ``regrow_after > 0`` re-admits rank slots:
-    after that many seconds of HEALTHY running below target the gang is
-    deliberately preempted (SIGTERM → final checkpoints) and re-spawned at
-    the full target — a returned host joining back.  A re-admitted rank
-    that keeps dying simply shrinks the gang again (probation loop).
-    Only when the budget is exhausted AT ``min_workers`` does the job
-    fail.
+    that came up degraded), and ``regrow_after > 0`` re-admits rank slots
+    ONE at a time: after that many seconds of HEALTHY running below target
+    the gang is deliberately preempted (SIGTERM → final checkpoints) and
+    re-spawned one rank larger — a returned host joining back on
+    probation.  The countdown re-arms at every world size below target,
+    so growth steps +1 until the target is reached, and re-arms again
+    whenever a LATER culprit shrinks the gang below target a second time
+    (grow → shrink → grow cycles converge instead of sticking at the
+    shrunken size).  A re-admitted rank that keeps dying simply shrinks
+    the gang again (probation loop).  Only when the budget is exhausted
+    AT ``min_workers`` does the job fail.
 
     ``metrics_port`` (``--metrics-port``; docs/OBSERVABILITY.md §Live
     metrics) serves a merged gang ``/metrics`` on that port (0 =
@@ -972,14 +976,21 @@ def _supervise(num_workers, command, env_extra, force_cpu, max_restarts,
         history.append((incarnation, world, [p.returncode for p in procs]))
         if planned:
             # regrow: the gang was healthy below target long enough —
-            # preemption checkpoints are on disk, re-admit the missing
-            # rank slots at the full target world size
-            prev_world, world = world, target
+            # preemption checkpoints are on disk, re-admit ONE rank slot
+            # (not the full target in one jump: a partially-recovered
+            # fleet re-checks stability at each size, and a re-admitted
+            # host that is still bad costs one probation step, not a
+            # full-gang thrash).  The countdown re-arms at the top of
+            # the loop while world < target, so growth continues +1 at
+            # a time — and re-starts from scratch whenever a later
+            # culprit shrinks the gang below target again.
+            prev_world, world = world, min(target, world + 1)
             incarnation += 1
             attempt = 0
             print(f"launch.py: growing gang {prev_world} -> {world} ranks "
-                  f"(stable for {regrow_after:.1f}s below target); "
-                  "re-rendezvous on a fresh port", file=sys.stderr)
+                  f"(stable for {regrow_after:.1f}s below target "
+                  f"{target}); re-rendezvous on a fresh port",
+                  file=sys.stderr)
             continue
         if rc == 0:
             # every rank is reaped: the trace files are complete, so the
@@ -1073,8 +1084,11 @@ def main(argv=None) -> int:
     ap.add_argument("--regrow-after", type=float, default=0.0, metavar="S",
                     help="elastic: after S seconds of healthy running "
                          "below the -n target, preempt the gang (final "
-                         "checkpoints) and re-spawn at the full target — "
-                         "the grow half of the resize (default 0 = never)")
+                         "checkpoints) and re-spawn ONE rank larger, "
+                         "repeating (with a fresh countdown at each "
+                         "size) until the target is reached; re-arms "
+                         "after any later shrink — the grow half of the "
+                         "resize (default 0 = never)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run on every worker")
     args = ap.parse_args(argv)

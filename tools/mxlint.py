@@ -91,7 +91,11 @@ HOT_PATH_ENTRIES = {
         # superstep mode: the group dispatch body and the scan-body
         # builder (its nested lax.scan body is the hottest path in the
         # tree — K steps per dispatch ride through it)
-        "DataParallelStep._superstep_impl", "DataParallelStep._super_fn"),
+        "DataParallelStep._superstep_impl", "DataParallelStep._super_fn",
+        # the unified Plan dispatch body: EVERY compiled-step execution
+        # (single step or superstep, any strategy Plan) funnels through
+        # it — a host sync here would stall every strategy at once
+        "DataParallelStep._plan_dispatch"),
     "mxnet_tpu/optimizer/fused.py": ("FusedUpdater._apply_impl",),
     "mxnet_tpu/parallel/async_loss.py": (
         "InflightRing.make_room", "InflightRing.admit",
