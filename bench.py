@@ -367,6 +367,38 @@ def main():
             result["plan_choice"] = pc
             print(json.dumps(result), flush=True)
 
+    # amp_step: graph-level AMP pass on-vs-off step wall on the compiled
+    # train step, plus a convergence smoke (bf16 losses must track the
+    # fp32 oracle within the documented tolerance — docs/PRECISION.md).
+    # On CPU the ratio is informational (XLA:CPU emulates bf16); on TPU
+    # the MXU issue-rate/HBM win is the point.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_AMP", "1") != "0"
+            and "error" not in result):
+        amp = _run_child(result.get("platform", "cpu"), float(os.environ.get(
+            "BENCH_AMP_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "amp_step"})
+        if amp is not None:
+            amp.pop("probe_history", None)
+            result["amp_step"] = amp
+            print(json.dumps(result), flush=True)
+
+    # quantized_serving: calibrated int8 serving engine vs the fp32
+    # engine on the reverse-task model — tokens/sec, params-bytes, and
+    # greedy top-1 agreement (docs/PRECISION.md §Int8 serving).  The
+    # params-bytes reduction is exact on any host; the latency share
+    # needs the MXU int8 path to show its full size.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_QUANT", "1") != "0"
+            and "error" not in result):
+        qs = _run_child("cpu", float(os.environ.get(
+            "BENCH_QUANT_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "quantized_serving"})
+        if qs is not None:
+            qs.pop("probe_history", None)
+            result["quantized_serving"] = qs
+            print(json.dumps(result), flush=True)
+
     # telemetry_overhead: steps/sec with the recorder + span tracing ON vs
     # fully off — the "observability must be cheap enough to leave on"
     # claim (docs/OBSERVABILITY.md §Tracing) measured, not asserted.
@@ -466,11 +498,11 @@ def main():
 
 
 def _iq_mean(xs):
-    """Interquartile mean of chunk times — the estimator both overhead
-    secondaries (telemetry_overhead, memwatch_overhead) share: this box
-    drifts 2x at sub-second scale, and the middle half drops both the
-    daemon-stomped chunks and the lucky turbo ones that keep fooling
-    min/median estimators here."""
+    """Interquartile mean of chunk times — the estimator the overhead
+    and precision secondaries (telemetry_overhead, memwatch_overhead,
+    amp_step, quantized_serving) share: this box drifts 2x at sub-second
+    scale, and the middle half drops both the daemon-stomped chunks and
+    the lucky turbo ones that keep fooling min/median estimators here."""
     xs = sorted(xs)
     lo, hi = len(xs) // 4, max(len(xs) // 4 + 1, 3 * len(xs) // 4)
     mid = xs[lo:hi]
@@ -1398,6 +1430,208 @@ def bench_cold_start(platform):
     }))
 
 
+def bench_amp_step(platform):
+    """Secondary metric: the graph-level AMP pass on-vs-off
+    (docs/PRECISION.md) — steady-state step wall of the compiled
+    DataParallelStep with the bf16 cast policy + traced dynamic loss
+    scaling vs plain f32, interquartile mean over interleaved trials
+    (the telemetry_overhead estimator).  A convergence smoke rides
+    along: the AMP trajectory must track the fp32 oracle within the
+    documented tolerance, or the speed number is meaningless.  On
+    XLA:CPU bf16 is emulated, so value ~1.0 is expected there; the MXU
+    issue-rate/HBM win is a TPU fact — the record carries the platform
+    so eras read it accordingly."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+    from mxnet_tpu.precision import (AmpPolicy, LossScaleConfig,
+                                     PrecisionConfig)
+
+    B = int(os.environ.get("BENCH_AMP_BATCH", 256))
+    D = int(os.environ.get("BENCH_AMP_DIM", 1024))
+    H = int(os.environ.get("BENCH_AMP_HIDDEN", 2048))
+    steps = int(os.environ.get("BENCH_AMP_STEPS", 6))
+    trials = int(os.environ.get("BENCH_AMP_TRIALS", 8))
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(B, D).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, B).astype(np.float32))
+    prec = PrecisionConfig(amp=AmpPolicy(),
+                           loss_scale=LossScaleConfig(init_scale=2.0 ** 10,
+                                                      growth_interval=1000))
+
+    def build(precision):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(H, activation="relu", in_units=D),
+                    nn.Dense(10, in_units=H))
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        return DataParallelStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            mesh=local_mesh(devices=[ctx.jax_device]), optimizer="sgd",
+            optimizer_params={"learning_rate": 1e-2}, precision=precision)
+
+    def trial(step):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = step.step(x, y)
+        step.drain()
+        v = float(loss)
+        return (time.perf_counter() - t0) / steps, v
+
+    s32, samp = build(None), build(prec)
+    trial(s32), trial(samp)  # compile outside the timed trials
+    w32, wamp = [], []
+    for _ in range(trials):  # interleave: box drift hits both alike
+        w32.append(trial(s32)[0])
+        wamp.append(trial(samp)[0])
+
+    # convergence smoke on FRESH nets: losses must track fp32
+    c32, camp = build(None), build(prec)
+    tr32 = [float(c32.step(x, y)) for _ in range(10)]
+    tramp = [float(camp.step(x, y)) for _ in range(10)]
+    c32.drain(), camp.drain()
+    max_dev = max(abs(a - b) for a, b in zip(tr32, tramp))
+    loss_tol = float(os.environ.get("BENCH_AMP_LOSS_TOL", 0.05))
+
+    f32_ms, amp_ms = _iq_mean(w32) * 1e3, _iq_mean(wamp) * 1e3
+    print(json.dumps({
+        "metric": "amp_step",
+        "value": round(f32_ms / amp_ms, 3) if amp_ms else 0.0,
+        "unit": "x_fp32_vs_amp_step_wall",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "fp32_step_ms": round(f32_ms, 3),
+        "amp_step_ms": round(amp_ms, 3),
+        "loss_max_abs_dev": round(max_dev, 5),
+        "loss_tol": loss_tol,
+        "losses_track_fp32": bool(max_dev <= loss_tol),
+        "final_scale": float(np.asarray(camp.scaler_state["scale"])),
+        "skipped_steps": int(np.asarray(camp.scaler_state["skipped"])),
+        "batch": B, "dim": D, "hidden": H,
+        "steps": steps, "trials": trials,
+    }))
+
+
+def bench_quantized_serving(platform):
+    """Secondary metric: the calibrated int8 serving engine vs the fp32
+    engine (docs/PRECISION.md §Int8 serving) on the reverse-task
+    transformer — tokens/sec ratio, params-bytes, and greedy top-1
+    agreement (the number that gates whether the int8 program may serve
+    at all).  The params-bytes ratio is the quantized PROGRAM's weight
+    footprint (docs/PRECISION.md §Params-bytes accounting — the process
+    here still holds the fp32 net, so its live memory is fp32+int8);
+    it is exact on any host.  The tokens/sec share needs real MXU int8
+    to show its full size, so the agreement + bytes are the
+    load-bearing CPU facts."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.transformer import Transformer, label_smoothed_ce
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+    from mxnet_tpu.precision import quantize_adapter
+    from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+    n_req = int(os.environ.get("BENCH_QUANT_REQUESTS", 12))
+    trials = int(os.environ.get("BENCH_QUANT_TRIALS", 4))
+    train_steps = int(os.environ.get("BENCH_QUANT_TRAIN_STEPS", 48))
+    BOS, EOS, L = 1, 2, 6
+
+    mx.random.seed(0)
+    net = Transformer(16, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=20, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    rng = np.random.RandomState(2)
+    src = np.zeros((8, L + 1), np.int32)
+    tgt_in = np.zeros((8, L + 2), np.int32)
+    tgt_out = np.zeros((8, L + 2), np.int32)
+    for b in range(8):
+        toks = rng.randint(3, 16, L)
+        src[b, :L] = toks
+        tgt_in[b, 0] = BOS
+        tgt_in[b, 1:L + 1] = toks[::-1]
+        tgt_out[b, :L] = toks[::-1]
+        tgt_out[b, L] = EOS
+    step = DataParallelStep(
+        net, lambda lo, la: label_smoothed_ce(lo, la, smoothing=0.0),
+        mesh=local_mesh(devices=[ctx.jax_device]), optimizer="adam",
+        optimizer_params={"learning_rate": 5e-3})
+    sb = nd.array(src, dtype="int32")
+    tb = nd.array(tgt_in, dtype="int32")
+    lb = nd.array(tgt_out.astype(np.float32))
+    for _ in range(train_steps):
+        step.step((sb, tb), lb)
+    step.sync_to_block()
+
+    def calib_fn(batch):
+        net.translate(nd.array(batch, dtype="int32"), bos_id=BOS,
+                      eos_id=EOS, max_len=10, beam_size=1)
+
+    qad = quantize_adapter(TransformerAdapter(net, src_max_len=7),
+                           [src[i:i + 1] for i in range(8)], calib_fn,
+                           calib_mode=os.environ.get("BENCH_QUANT_CALIB",
+                                                     "naive"))
+
+    def build(adapter):
+        eng = ServingEngine(adapter, slots=4, page_size=4, max_len=12,
+                            stream_every=4, ctx=ctx)
+        eng.serve([Request(src[0], 4, bos_id=BOS, eos_id=EOS)])  # warm
+        return eng
+
+    def run_trial(eng):
+        reqs = [Request(src[i % 8], max_new_tokens=9, bos_id=BOS,
+                        eos_id=EOS) for i in range(n_req)]
+        t0 = time.perf_counter()
+        out = eng.serve(reqs)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.stream) for r in reqs)
+        return toks / wall, {r.id: out[r.id] for r in reqs}, reqs
+
+    eng32 = build(TransformerAdapter(net, src_max_len=7))
+    engq = build(qad)
+    tps32, tpsq = [], []
+    last32 = lastq = None
+    for _ in range(trials):  # interleaved against box drift
+        v, o, r = run_trial(eng32)
+        tps32.append(v)
+        last32 = (o, r)
+        v, o, r = run_trial(engq)
+        tpsq.append(v)
+        lastq = (o, r)
+    agree = total = 0
+    for a, b in zip(last32[1], lastq[1]):
+        ta, tbq = list(last32[0][a.id]), list(lastq[0][b.id])
+        n = min(len(ta), len(tbq))
+        agree += sum(1 for i in range(n) if ta[i] == tbq[i])
+        total += max(len(ta), len(tbq))
+    thresh = float(os.environ.get("BENCH_QUANT_AGREE_THRESHOLD", 0.9))
+    print(json.dumps({
+        "metric": "quantized_serving",
+        "value": round(_iq_mean(tpsq) / _iq_mean(tps32), 3)
+                 if _iq_mean(tps32) else 0.0,
+        "unit": "x_int8_vs_fp32_tokens_per_sec",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "int8_tokens_per_sec": round(_iq_mean(tpsq), 2),
+        "fp32_tokens_per_sec": round(_iq_mean(tps32), 2),
+        "fp32_param_bytes": qad.fp32_param_bytes(),
+        "int8_param_bytes": qad.quantized_param_bytes(),
+        "param_bytes_ratio": round(
+            qad.quantized_param_bytes() / qad.fp32_param_bytes(), 3),
+        "top1_agreement": round(agree / total, 4) if total else 0.0,
+        "agreement_threshold": thresh,
+        "meets_agreement": bool(total and agree / total >= thresh),
+        "quantized_layers": len(qad._entries),
+        "requests": n_req, "trials": trials,
+    }))
+
+
 def child_main(platform):
     model = os.environ.get("BENCH_MODEL", "resnet")
     if model == "bert":
@@ -1412,6 +1646,10 @@ def child_main(platform):
         bench_serving_throughput(platform)
     elif model == "plan_choice":
         bench_plan_choice(platform)
+    elif model == "amp_step":
+        bench_amp_step(platform)
+    elif model == "quantized_serving":
+        bench_quantized_serving(platform)
     elif model == "telemetry_overhead":
         bench_telemetry_overhead(platform)
     elif model == "memwatch_overhead":

@@ -39,6 +39,7 @@ from . import module
 from . import module as mod
 from . import gluon
 from . import parallel
+from . import precision
 from . import io
 from . import image
 from . import callback

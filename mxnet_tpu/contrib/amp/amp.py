@@ -21,7 +21,14 @@ _amp_state = {
 
 
 class DynamicLossScaler:
-    """Dynamic loss scaling for fp16 (reference ~L400).  Unused for bf16."""
+    """Dynamic loss scaling for fp16 (reference ~L400).  Unused for bf16.
+
+    Compatibility shim over the precision subsystem
+    (docs/PRECISION.md): the scale/overflow protocol now lives in
+    ``mxnet_tpu.precision.loss_scale`` — compiled steps
+    (``DataParallelStep`` with a ``Plan.precision``) run it entirely on
+    device with NO host readback; this class remains for eager Trainer
+    scripts, delegating overflow detection to the same fused reduce."""
 
     def __init__(self, init_scale=2.0**16, scale_factor=2.0,
                  scale_window=2000, tolerance=0.0):
@@ -31,14 +38,27 @@ class DynamicLossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params) -> bool:
+        """ONE fused any-non-finite reduce over every gradient
+        (precision.loss_scale.overflow_flag), ONE host readback at this
+        python-bool API boundary.  The pre-precision body read every
+        gradient back to host individually (O(params) blocking syncs
+        per step — the pattern mxlint's hot-sync rule now guards this
+        entry point against)."""
+        from ...precision.loss_scale import overflow_flag
+
+        grads = []
         for param in params:
             if param.grad_req == "null" or param._grad is None:
                 continue
             for g in param.list_grad():
-                arr = g.asnumpy()
-                if not np.isfinite(arr).all():
-                    return True
-        return False
+                grads.append(g._data)
+        if not grads:
+            return False
+        flag = overflow_flag(grads)
+        # mxlint: disable=hot-sync — the eager API contract returns a
+        # python bool: exactly ONE deferred readback for the WHOLE
+        # gradient set (the compiled-step path never syncs at all)
+        return bool(np.asarray(flag))
 
     def update_scale(self, overflow: bool) -> None:
         if overflow:

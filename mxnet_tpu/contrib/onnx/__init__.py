@@ -20,9 +20,10 @@ from ...base import MXNetError
 from . import proto
 from .mx2onnx import export_symbol
 from .onnx2mx import import_onnx_model
+from .quant_export import export_quantized_net
 
 __all__ = ["export_model", "import_model", "import_to_gluon",
-           "get_model_metadata"]
+           "get_model_metadata", "export_quantized_net"]
 
 
 def _load_symbol(sym):

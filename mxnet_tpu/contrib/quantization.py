@@ -29,7 +29,37 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["quantize_net", "quantize_model", "calib_entropy_threshold",
-           "QuantizedDense", "QuantizedConv2D"]
+           "check_calibrated_threshold", "QuantizedDense", "QuantizedConv2D"]
+
+
+def check_calibrated_threshold(path: str, calib_mode: str, minmax,
+                               thresh: float) -> None:
+    """Reject a zero/degenerate calibration threshold LOUDLY, naming the
+    layer and calibration mode.
+
+    A layer whose calibration batches produced only zeros (or whose
+    activations were non-finite) yields a floor/garbage threshold; a 0.0
+    scale would then silently quantize every activation to zero — the
+    quantized net "works" and emits nonsense.  Both ``quantize_net`` and
+    ``precision.quantize`` route every per-layer threshold through here.
+    """
+    mn, mx = (float(minmax[0]), float(minmax[1])) if minmax else (0.0, 0.0)
+    amax = max(abs(mn), abs(mx))
+    if not np.isfinite(thresh) or not np.isfinite(amax):
+        raise MXNetError(
+            f"quantization calibration for layer {path!r} "
+            f"(calib_mode={calib_mode!r}) observed non-finite activations "
+            f"(range [{mn}, {mx}]) — the model is diverging or the "
+            f"calibration data is corrupt; quantizing would bake NaN/inf "
+            f"scales into the int8 graph")
+    if amax <= 0.0 or thresh <= 0.0:
+        raise MXNetError(
+            f"quantization calibration for layer {path!r} "
+            f"(calib_mode={calib_mode!r}) produced a degenerate threshold "
+            f"(observed activation range [{mn}, {mx}]): every calibrated "
+            f"activation is zero, so int8 quantization would map the "
+            f"layer's real inputs to zero.  Calibrate with representative "
+            f"data, or exclude the layer (exclude_layers)")
 
 
 # ---------------------------------------------------------------------------
@@ -162,7 +192,10 @@ def _quantize_weight_np(w: np.ndarray):
 
 class _QuantizedLayerBase:
     """Shared inference-only behavior: quantize input, run int8 kernel,
-    dequantize the int32 accumulator back to f32."""
+    dequantize the int32 accumulator back to f32.  ``_forward`` is
+    F-generic so the SAME lowering serves the eager per-call twins here
+    and the traced serving rewrite in ``precision/quantize.py`` — the
+    int8 call sequence exists exactly once."""
 
     def _q_input(self, F, x):
         if self._calib_thresh is not None:
@@ -170,6 +203,11 @@ class _QuantizedLayerBase:
                 x, min_calib_range=-self._calib_thresh,
                 max_calib_range=self._calib_thresh)
         return F.contrib.quantize_v2(x)
+
+    def __call__(self, x):
+        from .. import nd
+
+        return self._forward(nd, x, self._bias)
 
 
 class QuantizedDense(_QuantizedLayerBase):
@@ -182,6 +220,7 @@ class QuantizedDense(_QuantizedLayerBase):
         # constants built ONCE (inference hot path)
         self._w_min = nd.array([-tw])
         self._w_max = nd.array([tw])
+        self._w_thresh = float(tw)
         self._no_bias = dense.bias is None
         self._bias = (dense.bias.data() if dense.bias is not None
                       else nd.zeros((dense._units,)))
@@ -189,18 +228,18 @@ class QuantizedDense(_QuantizedLayerBase):
         self._flatten = getattr(dense, "_flatten", True)
         self._act_type = dense._act_type
         self._calib_thresh = calib_thresh
+        self.orig_nbytes = int(w.nbytes)
+        self.nbytes = int(qw.nbytes)
 
-    def __call__(self, x):
-        from .. import nd
-
-        qx, mn, mx = self._q_input(nd, x)
-        acc, amn, amx = nd.contrib.quantized_fully_connected(
-            qx, self._qweight, self._bias,
+    def _forward(self, F, x, bias):
+        qx, mn, mx = self._q_input(F, x)
+        acc, amn, amx = F.contrib.quantized_fully_connected(
+            qx, self._qweight, bias,
             mn, mx, self._w_min, self._w_max,
             num_hidden=self._units, no_bias=self._no_bias,
             flatten=self._flatten)
-        out = nd.contrib.dequantize(acc, amn, amx)
-        return (nd.Activation(out, act_type=self._act_type)
+        out = F.contrib.dequantize(acc, amn, amx)
+        return (F.Activation(out, act_type=self._act_type)
                 if self._act_type else out)
 
 
@@ -213,6 +252,7 @@ class QuantizedConv2D(_QuantizedLayerBase):
         self._qweight = nd.array(qw, dtype=np.int8)
         self._w_min = nd.array([-tw])
         self._w_max = nd.array([tw])
+        self._w_thresh = float(tw)
         self._kwargs = dict(conv._kwargs)
         nf = int(self._kwargs["num_filter"])
         self._no_bias = conv.bias is None
@@ -220,22 +260,22 @@ class QuantizedConv2D(_QuantizedLayerBase):
                       else nd.zeros((nf,)))
         self._act_type = conv._act_type
         self._calib_thresh = calib_thresh
+        self.orig_nbytes = int(w.nbytes)
+        self.nbytes = int(qw.nbytes)
 
-    def __call__(self, x):
-        from .. import nd
-
-        qx, mn, mx = self._q_input(nd, x)
+    def _forward(self, F, x, bias):
+        qx, mn, mx = self._q_input(F, x)
         k = self._kwargs
-        acc, amn, amx = nd.contrib.quantized_conv(
-            qx, self._qweight, self._bias,
+        acc, amn, amx = F.contrib.quantized_conv(
+            qx, self._qweight, bias,
             mn, mx, self._w_min, self._w_max,
             kernel=k["kernel"], stride=k.get("stride", ()),
             dilate=k.get("dilate", ()), pad=k.get("pad", ()),
             num_filter=int(k["num_filter"]),
             num_group=k.get("num_group", 1),
             no_bias=self._no_bias)
-        out = nd.contrib.dequantize(acc, amn, amx)
-        return (nd.Activation(out, act_type=self._act_type)
+        out = F.contrib.dequantize(acc, amn, amx)
+        return (F.Activation(out, act_type=self._act_type)
                 if self._act_type else out)
 
 
@@ -247,6 +287,19 @@ class _QuantizedWrapper:
 
     def __call__(self, x):
         return self._impl(x)
+
+
+def _active_blocks(block, found):
+    """Every block under ``block`` with a live CachedOp fast path
+    (``hybridize()``d).  Forward pre-hooks do not fire through the
+    cached graph, so BOTH calibration drivers (``quantize_net`` here,
+    ``precision.quantize.calibrate`` for serving) deactivate these for
+    the eager calibration pass and restore them after."""
+    if getattr(block, "_active", False):
+        found.append(block)
+    for child in getattr(block, "_children", {}).values():
+        _active_blocks(child, found)
+    return found
 
 
 # ---------------------------------------------------------------------------
@@ -311,13 +364,6 @@ def quantize_net(network, calib_data=None, calib_mode: str = "naive",
             hooks.append((layer, hook))
         # forward pre-hooks do not fire through the CachedOp fast path —
         # run calibration eagerly, restoring hybridization afterwards
-        def _active_blocks(block, found):
-            if getattr(block, "_active", False):
-                found.append(block)
-            for child in getattr(block, "_children", {}).values():
-                _active_blocks(child, found)
-            return found
-
         hybridized = _active_blocks(network, [])
         for b in hybridized:
             b._active = False
@@ -334,7 +380,13 @@ def quantize_net(network, calib_data=None, calib_mode: str = "naive",
                 layer._forward_pre_hooks.remove(hook)
             for b in hybridized:
                 b._active = True
-        thresholds = {p: calib.threshold(p) for *_, p in targets}
+        thresholds = {}
+        for *_, p in targets:
+            t = calib.threshold(p)
+            # a degenerate (all-zero / non-finite) calibration is a data
+            # bug, not a preference — fail naming the layer and mode
+            check_calibrated_threshold(p, calib_mode, calib.minmax.get(p), t)
+            thresholds[p] = t
 
     # build the quantized net: a thin tree mirror whose quantizable leaves
     # are int8 twins; untouched blocks are SHARED with the original (their

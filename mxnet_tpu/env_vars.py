@@ -262,6 +262,37 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "'sp' pin the corresponding axis family; 'ring'/'ulysses' "
         "additionally select the SP attention mechanism "
         "(parallel/planner.py plan_for)"),
+    # precision subsystem: graph-level AMP, traced loss scaling, int8
+    # serving (docs/PRECISION.md)
+    "MX_AMP": (
+        "honored", "enables the graph-level AMP cast pass for compiled "
+        "steps built without an explicit Plan.precision: bf16/bfloat16/1 "
+        "or fp16/float16 (fp16 defaults dynamic loss scaling on); read "
+        "ONCE at step construction and recorded on the Plan "
+        "(precision/config.py PrecisionConfig.from_env)"),
+    "MX_AMP_POLICY": (
+        "honored", "inline-JSON override of the AMP op-class lists: "
+        '{"low": [...], "widen": [...], "dtype": ...} — low-class ops '
+        "compute in the AMP dtype, widen-class ops force f32 "
+        "(precision/config.py AmpPolicy)"),
+    "MX_LOSS_SCALE": (
+        "honored", "traced dynamic loss scaling config under MX_AMP: "
+        "'dynamic' (or 1), a fixed scale float (static), or 0/off; "
+        "unset = on for fp16, off for bf16.  All scale/overflow/skip "
+        "transitions run inside the compiled step as device values "
+        "(precision/loss_scale.py)"),
+    "MX_QUANTIZE": (
+        "honored", "int8 (or 1) routes maybe_quantize_adapter to build a "
+        "calibrated int8 serving adapter — Dense/Conv in the traced "
+        "decode/prefill graphs lower onto the ops/quantization.py int8 "
+        "primitives; the quant config joins the AOT-cache fingerprint "
+        "so a restart under different settings misses "
+        "(precision/quantize.py)"),
+    "MX_QUANT_CALIB": (
+        "honored", "calibration mode for MX_QUANTIZE: naive (per-layer "
+        "min/max, default) or entropy (KL-optimal threshold over a "
+        "streaming histogram) (precision/quantize.py; calibrators from "
+        "contrib/quantization.py)"),
     # memory & compile observability (docs/OBSERVABILITY.md §Memory)
     "MX_MEMWATCH": (
         "honored", "device-memory watchdog riding the telemetry "

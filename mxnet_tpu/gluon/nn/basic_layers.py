@@ -12,6 +12,7 @@ import numpy as np
 
 from ...base import MXNetError
 from ... import initializer as init_mod
+from ...precision.runtime import quant_entry
 from ..block import Block, HybridBlock
 from ..parameter import record_aux_update
 
@@ -118,6 +119,12 @@ class Dense(HybridBlock):
         self.weight._set_shape_if_deferred((self._units, in_units))
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        twin = quant_entry(self)
+        if twin is not None:
+            # active precision.quant_scope (int8 serving): route through
+            # the calibrated int8 twin — the scope is only ever set
+            # around a QuantizedAdapter's traced prefill/decode bodies
+            return twin(F, x, bias)
         if bias is None:
             out = F.FullyConnected(x, weight, num_hidden=self._units,
                                    no_bias=True, flatten=self._flatten)

@@ -14,6 +14,7 @@ import numpy as np
 
 from ...base import MXNetError
 from ...ops.nn import _channels_last
+from ...precision.runtime import quant_entry
 from ..block import HybridBlock
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
@@ -81,6 +82,11 @@ class _Conv(HybridBlock):
             self.bias._set_shape_if_deferred((self._channels,))
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        twin = quant_entry(self)
+        if twin is not None:
+            # active precision.quant_scope (int8 serving): the calibrated
+            # int8 twin replaces the f32 conv inside the traced graph
+            return twin(F, x, bias)
         op = getattr(F, self._op_name)
         if bias is None:
             out = op(x, weight, **self._kwargs)

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..base import MXNetError, canonical_kwargs
 from .. import engine
+from ..precision import runtime as _precision
 
 __all__ = ["Operator", "register", "get_op", "invoke", "list_ops"]
 
@@ -154,6 +155,13 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
     from ..ndarray import NDArray
     from .. import autograd
 
+    if _precision._AMP_POLICY is not None and inputs:
+        # graph-level AMP pass (docs/PRECISION.md): inside an active
+        # amp_scope — i.e. during the one trace DataParallelStep._build
+        # runs — low-class ops take policy-dtype inputs, widen-class ops
+        # take f32.  The module-global None check above is the entire
+        # AMP-off cost: the default dispatch path is unchanged.
+        inputs = _precision.cast_inputs(op.name, inputs)
     arrays = [x._data for x in inputs]
     if inputs:
         ctx = inputs[0].context

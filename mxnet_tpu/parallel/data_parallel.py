@@ -249,7 +249,8 @@ class DataParallelStep:
                  ring_attention: bool = False, accum_steps: int = 1,
                  clip_global_norm: Optional[float] = None,
                  pp_microbatches: int = 4,
-                 plan: Optional[Plan] = None):
+                 plan: Optional[Plan] = None,
+                 precision=None):
         """seq_axis: which input dim is the sequence dim for sequence
         parallelism over an 'sp' mesh axis.  None (default) auto-detects:
         dim 1 is treated as the sequence dim only when it is divisible by
@@ -294,6 +295,14 @@ class DataParallelStep:
         for maximum effective batch per chip (reference analog:
         grad_req='add' + delayed Trainer.step).
 
+        precision: a :class:`~mxnet_tpu.precision.config.PrecisionConfig`
+        — the graph-level AMP cast policy and/or traced dynamic loss
+        scaling (docs/PRECISION.md).  Carried on the Plan (so it rides
+        into checkpoint layouts and elastic restores); ``MX_AMP`` /
+        ``MX_LOSS_SCALE`` provide the env default when neither the plan
+        nor this kwarg sets one.  With no precision config, the built
+        step program is byte-for-byte the pre-precision f32 program.
+
         plan: a :class:`~mxnet_tpu.parallel.plan.Plan` carrying ALL of
         the strategy knobs above (rules/batch_axes/seq_axis/
         ring_attention/accum_steps/pp_microbatches) as one value — the
@@ -314,6 +323,7 @@ class DataParallelStep:
                 ("ring_attention", ring_attention, False),
                 ("accum_steps", accum_steps, 1),
                 ("pp_microbatches", pp_microbatches, 4),
+                ("precision", precision, None),
             ) if val != dflt]
             if clash:
                 raise MXNetError(
@@ -352,8 +362,25 @@ class DataParallelStep:
                 seq_axis=seq_axis,
                 sp_attention=sp_mode,
                 pp_microbatches=int(pp_microbatches),
-                accum_steps=int(accum_steps))
+                accum_steps=int(accum_steps),
+                precision=precision)
+        if plan.precision is None:
+            # env default (MX_AMP / MX_AMP_POLICY / MX_LOSS_SCALE), read
+            # ONCE here: the resolved config becomes part of the Plan —
+            # and therefore of checkpoint layouts and executable
+            # fingerprints — so a mid-run env flip cannot silently split
+            # the program from its recorded identity
+            from dataclasses import replace as _dc_replace
+
+            from ..precision.config import PrecisionConfig
+
+            env_precision = PrecisionConfig.from_env()
+            if env_precision is not None:
+                plan = _dc_replace(plan, precision=env_precision)
         self.plan = plan
+        self._precision = plan.precision
+        self._loss_scale_cfg = (plan.precision.loss_scale
+                                if plan.precision is not None else None)
         self.mesh = mesh
         self.block = block
         self.loss_fn = loss_fn
@@ -396,6 +423,10 @@ class DataParallelStep:
                            f"#{DataParallelStep._instance_counter}")
         self.params = None
         self.opt_state = None
+        # traced loss-scale state (docs/PRECISION.md): replicated device
+        # scalars {scale, growth, skipped} threaded through the jitted
+        # step; None when the plan carries no loss-scale config
+        self.scaler_state = None
         self._shardings = None
         self._jitted = None
         self._step_count = 0
@@ -473,6 +504,16 @@ class DataParallelStep:
                                      self._shardings[n]) for n in names}
                 self.opt_state = (z, z2,
                                   jax.numpy.zeros((), jax.numpy.int32))
+            if self._loss_scale_cfg is not None and \
+                    self.scaler_state is None:
+                from ..precision import loss_scale as _ls
+
+                repl = replicated(self.mesh)
+                self.scaler_state = {
+                    k: _global_put(v, repl)
+                    for k, v in _ls.init_scaler_host(
+                        self._loss_scale_cfg).items()
+                }
             # publish params LAST: it is the unlocked fast-path check
             self.params = params
 
@@ -501,6 +542,14 @@ class DataParallelStep:
             def apply_fn(params, key, *xs):
                 out, vals = ck(params, key, *xs)
                 return out, list(zip(names_cell[0], vals))
+        if self._precision is not None and self._precision.amp is not None:
+            # graph-level AMP pass (docs/PRECISION.md): the policy scope
+            # is active during THIS trace only, so the whole
+            # mixed-precision program lands in the one compiled
+            # executable; block outputs widen to f32 at the boundary
+            from ..precision.amp_pass import apply_amp
+
+            apply_fn = apply_amp(apply_fn, self._precision.amp)
         loss_fn = self.loss_fn
         opt = self._optimizer
         momentum, wd, rescale = self._momentum, self._wd, self._rescale
@@ -521,11 +570,33 @@ class DataParallelStep:
             return jnp.mean(larr.astype(jnp.float32)), aux
 
         accum = self.plan.accum_steps
+        ls_cfg = self._loss_scale_cfg
 
-        def step(params, opt_state, key, lr, data, label):
+        def _update_core(params, opt_state, key, lr, data, label, scale):
+            """ONE copy of the grad/accum/clip/optimizer body shared by
+            ``step`` and ``scaled_step``.  ``scale=None`` is the plain
+            f32 program — no scaling op is emitted, so the unscaled
+            trace stays byte-identical to the pre-AMP step (pinned by
+            the AMP-off bitwise test).  A device ``scale`` folds the
+            loss multiply in before value_and_grad and the un-scale into
+            the optimizer's rescale multiply (zero extra HBM passes over
+            the gradient buffers).  Returns grads too, for the caller's
+            overflow check."""
+            if scale is None:
+                vg_target = loss_of
+            else:
+                def vg_target(params, key, data, label):
+                    loss, aux = loss_of(params, key, data, label)
+                    return loss * scale, (loss, aux)
+
+            def run_vg(p, k, d, l):
+                out, grads = jax.value_and_grad(
+                    vg_target, has_aux=True)(p, k, d, l)
+                loss, aux = out if scale is None else out[1]
+                return loss, aux, grads
+
             if accum == 1:
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(params, key, data, label)
+                loss, aux, grads = run_vg(params, key, data, label)
             else:
                 # statically-unrolled microbatch loop.  STRIDED slices
                 # (rows i::accum): each microbatch draws an equal share of
@@ -537,10 +608,9 @@ class DataParallelStep:
                 for i in range(accum):
                     def mb(a, _i=i):
                         return a[_i::accum]
-                    (l_i, aux), g_i = jax.value_and_grad(
-                        loss_of, has_aux=True)(
-                            params, keys[i], tuple(mb(a) for a in data),
-                            mb(label))
+                    l_i, aux, g_i = run_vg(
+                        params, keys[i], tuple(mb(a) for a in data),
+                        mb(label))
                     loss = loss + l_i / accum
                     # aux (BN batch stats) averages over ALL microbatches,
                     # keeping the "global batch average" contract below
@@ -551,15 +621,17 @@ class DataParallelStep:
                         lambda a, b: a + b, grads, g_i))
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 aux = [(n, v / accum) for n, v in aux_sums.items()]
-            eff_rescale = rescale
+            base_rescale = rescale if scale is None else rescale / scale
+            eff_rescale = base_rescale
             if clip_global is not None:
                 # ONE fused global-norm reduction over the rescaled grads of
                 # the trainable params, folded into the per-param rescale
                 sq = sum(
-                    jnp.sum(jnp.square(grads[n].astype(jnp.float32) * rescale))
+                    jnp.sum(jnp.square(grads[n].astype(jnp.float32)
+                                       * base_rescale))
                     for n in grads if mults.get(n, (1.0, 1.0))[0] is not None)
                 gnorm = jnp.sqrt(sq)
-                eff_rescale = rescale * jnp.minimum(
+                eff_rescale = base_rescale * jnp.minimum(
                     1.0, clip_global / (gnorm + 1e-12))
             if opt == "sgd":
                 new_params, new_state = _sgd_tree_update(
@@ -572,7 +644,35 @@ class DataParallelStep:
             # aux (BN stats): already averaged over the global batch by XLA
             for name, val in aux:
                 new_params[name] = val.astype(new_params[name].dtype)
+            return new_params, new_state, loss, grads
+
+        def step(params, opt_state, key, lr, data, label):
+            new_params, new_state, loss, _grads = _update_core(
+                params, opt_state, key, lr, data, label, None)
             return new_params, new_state, loss
+
+        def scaled_step(params, opt_state, scaler, key, lr, data, label):
+            """The loss-scaled twin of ``step`` (docs/PRECISION.md):
+            same ``_update_core`` with the scale folded in, overflow
+            detection is one fused isfinite reduce, and a non-finite
+            step SELECTS the old params/opt_state — a traced no-op
+            update.  The scaler state machine transitions as device
+            values; no host readback ever enters this body."""
+            from ..precision import loss_scale as _ls
+
+            new_params, new_state, loss, grads = _update_core(
+                params, opt_state, key, lr, data, label, scaler["scale"])
+            finite = _ls.grads_finite(grads, mults)
+            # skip-step selection: weights, momenta, Adam's t AND the
+            # forward's aux stats all hold when any grad is non-finite
+            def hold(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old)
+
+            new_params = hold(new_params, params)
+            new_state = hold(new_state, opt_state)
+            new_scaler = _ls.scaler_update(scaler, finite, ls_cfg)
+            return new_params, new_state, new_scaler, loss
 
         repl = replicated(self.mesh)
         # XLA:CPU's runtime aliasing check rejects a donated param whose
@@ -582,13 +682,23 @@ class DataParallelStep:
         # so keep it for accelerators and skip it on CPU hosts.
         mesh_platform = next(iter(self.mesh.devices.flat)).platform
         donate = (0, 1) if (self._donate and mesh_platform != "cpu") else ()
-        # mxlint: disable=retrace-hazard — built ONCE per step object
-        # (guarded by `self._jitted is None` in _step_impl)
-        self._jitted = jax.jit(
-            step,
-            out_shardings=(self._shardings, None, repl),
-            donate_argnums=donate,
-        )
+        # built ONCE per step object (guarded by `self._jitted is None`
+        # in _step_impl); ls_cfg is construction-time state, so exactly
+        # one of the two programs ever exists per step object
+        if ls_cfg is None:
+            # mxlint: disable=retrace-hazard — built once per step object
+            self._jitted = jax.jit(
+                step,
+                out_shardings=(self._shardings, None, repl),
+                donate_argnums=donate,
+            )
+        else:
+            # mxlint: disable=retrace-hazard — built once per step object
+            self._jitted = jax.jit(
+                scaled_step,
+                out_shardings=(self._shardings, None, None, repl),
+                donate_argnums=donate,
+            )
 
     # ------------------------------------------------------------------
     def _input_shardings(self, data_arrs, label_arr):
@@ -799,14 +909,22 @@ class DataParallelStep:
         lr_val = np.float32(self._current_lr(self._step_count + 1))
         with telemetry.span("dispatch", step=self._step_count + 1,
                             traced=traced):
-            call_args = (self.params, self.opt_state, key, lr_val,
-                         data_arrs, label_arr)
+            scaled = self.scaler_state is not None
+            call_args = ((self.params, self.opt_state, self.scaler_state,
+                          key, lr_val, data_arrs, label_arr) if scaled
+                         else (self.params, self.opt_state, key, lr_val,
+                               data_arrs, label_arr))
             resolve = ((lambda a, p: self._resolve_aot(sig, a, p))
                        if aot_on else None)
-            self.params, self.opt_state, loss = self._plan_dispatch(
+            outs = self._plan_dispatch(
                 self._jitted, call_args, (self._step_count + 1,),
                 sp_active, resolve,
                 f"FusedStep:{type(self.block).__name__}")
+            if scaled:
+                (self.params, self.opt_state, self.scaler_state,
+                 loss) = outs
+            else:
+                self.params, self.opt_state, loss = outs
         if traced and telemetry.enabled():
             # what step() needs to book the compile once the hot body is
             # done: structural fingerprint parts + arg shape mirrors
@@ -884,7 +1002,13 @@ class DataParallelStep:
                      self.plan.pp_microbatches,
                      self.plan.batch_axes, self.plan.seq_axis,
                      type(self.loss_fn).__name__,
-                     tuple(sorted(self._mults.items())))
+                     tuple(sorted(self._mults.items())),
+                     # the AMP policy + loss-scale config are executable
+                     # identity: a restart under a different MX_AMP /
+                     # MX_LOSS_SCALE must MISS the AOT cache, not load
+                     # the other precision's program
+                     self._precision.signature()
+                     if self._precision is not None else None)
         return (("DataParallelStep",) + tuple(variant)
                 + (type(self.block).__name__,
                    self._optimizer, self.plan.accum_steps, hyper_sig,
@@ -1152,14 +1276,22 @@ class DataParallelStep:
         with telemetry.span("dispatch", step=last_step, traced=traced,
                             superstep=k):
             fn = self._super_fn(k, mesh_platform)
-            call_args = (self.params, self.opt_state, keys, lrs,
-                         datas, label_arr)
+            scaled = self.scaler_state is not None
+            call_args = ((self.params, self.opt_state, self.scaler_state,
+                          keys, lrs, datas, label_arr) if scaled
+                         else (self.params, self.opt_state, keys, lrs,
+                               datas, label_arr))
             resolve = ((lambda a, p: self._resolve_super_aot(sig, fn, a, p))
                        if aot_on else None)
-            self.params, self.opt_state, losses = self._plan_dispatch(
+            outs = self._plan_dispatch(
                 fn, call_args, tuple(e["step"] for e in entries),
                 sp_active, resolve,
                 f"Superstep:{type(self.block).__name__}")
+            if scaled:
+                (self.params, self.opt_state, self.scaler_state,
+                 losses) = outs
+            else:
+                self.params, self.opt_state, losses = outs
         if traced and telemetry.enabled():
             cache_info = self._last_cache_info
             self._last_cache_info = {}
@@ -1226,11 +1358,34 @@ class DataParallelStep:
                                       (keys, lrs, datas, label))
             return p, o, losses
 
-        # mxlint: disable=retrace-hazard — built once per scan length K,
-        # cached in _super_jits
-        fn = jax.jit(superstep_body,
-                     out_shardings=(self._shardings, None, repl),
-                     donate_argnums=donate)
+        def superstep_body_scaled(params, opt_state, scaler, keys, lrs,
+                                  datas, label):
+            # loss-scaled twin: the scaler state joins the scan carry,
+            # so skip/backoff/regrow transitions happen per scanned step
+            # exactly as under sequential dispatch
+            def body(carry, xs):
+                p, o, s = carry
+                key, lr, data, lab = xs
+                p2, o2, s2, loss = inner(p, o, s, key, lr, data, lab)
+                return (p2, o2, s2), loss
+
+            (p, o, s), losses = lax.scan(
+                body, (params, opt_state, scaler),
+                (keys, lrs, datas, label))
+            return p, o, s, losses
+
+        # built once per scan length K, cached in _super_jits; the
+        # loss-scale config is construction-time state
+        if self._loss_scale_cfg is None:
+            # mxlint: disable=retrace-hazard — built once per K, cached
+            fn = jax.jit(superstep_body,
+                         out_shardings=(self._shardings, None, repl),
+                         donate_argnums=donate)
+        else:
+            # mxlint: disable=retrace-hazard — built once per K, cached
+            fn = jax.jit(superstep_body_scaled,
+                         out_shardings=(self._shardings, None, None, repl),
+                         donate_argnums=donate)
         self._super_jits[k] = fn
         return fn
 
@@ -1449,6 +1604,13 @@ class DataParallelStep:
             for n, a in vars_.items():
                 opt[f"var.{smap.get(n, n)}"] = host(a)
             opt["t"] = np.asarray(jax.device_get(t))
+        if self.scaler_state is not None:
+            # traced loss-scale state rides with the optimizer slots
+            # (replicated scalars: collective-free host reads), so a
+            # restore — same world or elastically resharded — resumes
+            # the scale trajectory instead of restarting at init_scale
+            for k in self.scaler_state:
+                opt[f"amp.{k}"] = host(self.scaler_state[k])
         return {"params": params, "opt_state": opt,
                 "optimizer": self._optimizer}
 
@@ -1509,6 +1671,11 @@ class DataParallelStep:
                 # restored weights)
                 p.set_data(host)
             opt = dict(state.get("opt_state") or {})
+            # scaler state travels under amp.* keys: pop it out before
+            # the per-param slot logic (it is not a parameter slot, and
+            # the partial-missing-slot check must not see it)
+            amp_state = {k[len("amp."):]: opt.pop(k)
+                         for k in list(opt) if k.startswith("amp.")}
             if not opt:
                 # legitimate (a params-only / legacy Block checkpoint)
                 # but never silent: momentum/Adam moments restart at zero
@@ -1546,6 +1713,35 @@ class DataParallelStep:
                 t = jnp.asarray(int(np.asarray(opt.get("t", 0))),
                                 jnp.int32)
                 opt_state = (m, v, t)
+            if self._loss_scale_cfg is not None:
+                from ..precision import loss_scale as _ls
+
+                import logging
+
+                fresh = _ls.init_scaler_host(self._loss_scale_cfg)
+                if not amp_state:
+                    # params-only / pre-precision checkpoint: resume
+                    # with a fresh scaler, loudly — the scale re-warms
+                    # from init_scale instead of its learned value
+                    logging.getLogger("mxnet_tpu.data_parallel").warning(
+                        "load_state_dict: checkpoint carries no amp.* "
+                        "loss-scale state — resuming with a FRESH scaler "
+                        "(scale=%s)", fresh["scale"])
+                host_scaler = {
+                    k: np.asarray(amp_state.get(k, fresh[k])).astype(
+                        np.asarray(fresh[k]).dtype)
+                    for k in _ls.SCALER_KEYS}
+                repl = replicated(self.mesh)
+                self.scaler_state = {
+                    k: _global_put(v, repl)
+                    for k, v in host_scaler.items()}
+            elif amp_state:
+                import logging
+
+                logging.getLogger("mxnet_tpu.data_parallel").warning(
+                    "load_state_dict: checkpoint carries amp.* loss-scale "
+                    "state but this step runs without loss scaling — "
+                    "ignoring it")
             # publish params LAST (the unlocked _ensure_state fast-path
             # check)
             self.opt_state = opt_state

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, Optional, Sequence, Tuple
 
 from ..base import MXNetError
+from ..precision.config import PrecisionConfig
 from .sharding import ShardingRules
 
 __all__ = ["Plan", "dp_plan", "tensor_parallel_plan", "pipeline_plan",
@@ -77,6 +78,10 @@ class Plan:
     sp_attention: str = "gspmd"
     pp_microbatches: int = 4
     accum_steps: int = 1
+    # the precision story travels WITH the layout (docs/PRECISION.md):
+    # an elastic restore must rebuild not just where each shard lived but
+    # what dtype program produced the checkpointed values
+    precision: Optional[PrecisionConfig] = None
     predicted: Optional[dict] = field(default=None, compare=False)
 
     def __post_init__(self):
@@ -191,6 +196,8 @@ class Plan:
             "sp_attention": self.sp_attention,
             "pp_microbatches": self.pp_microbatches,
             "accum_steps": self.accum_steps,
+            "precision": (self.precision.to_json()
+                          if self.precision is not None else None),
             "strategy": self.strategy,  # derived; informational on disk
         }
 
@@ -207,6 +214,7 @@ class Plan:
             sp_attention=rec.get("sp_attention", "gspmd"),
             pp_microbatches=int(rec.get("pp_microbatches", 4)),
             accum_steps=int(rec.get("accum_steps", 1)),
+            precision=PrecisionConfig.from_json(rec.get("precision")),
         )
 
     def with_predicted(self, predicted: dict) -> "Plan":

@@ -1,0 +1,104 @@
+"""Trace-time precision scopes: the one global the hot dispatch paths
+consult.
+
+Two scopes live here because their consumers sit on the hottest import
+paths of the package (``ops/registry.py`` dispatch and the gluon
+Dense/Conv forward) and must pay ONE module-global read when precision is
+off:
+
+  * :func:`amp_scope` — while active, :func:`cast_inputs` applies the
+    graph-level AMP cast policy at the op-dispatch point: ``low``-class
+    ops get f32 float inputs cast down to the policy dtype, ``widen``-
+    class ops get low-precision float inputs cast back up to f32.
+    Activated by ``DataParallelStep._build`` around the traced block
+    apply, so the casts are traced INTO the one compiled step program —
+    never per-op eager work.
+  * :func:`quant_scope` — while active, :func:`quant_entry` resolves a
+    Dense/Conv layer to its calibrated int8 twin
+    (``precision/quantize.py``); the layer's ``hybrid_forward`` then
+    routes through the int8 kernels inside the engine's traced
+    decode/prefill graphs.
+
+Scopes nest and restore (context managers); they are trace-time state,
+set around a jit trace or an eager region by exactly one thread — the
+same discipline as ``gluon.parameter.begin_trace``.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["amp_scope", "amp_active", "cast_inputs", "quant_scope",
+           "quant_entry"]
+
+_AMP_POLICY = None   # active AmpPolicy, or None (the fast-path check)
+_QUANT_MAP = None    # active {id(layer): quantized-twin}, or None
+
+
+def amp_active() -> bool:
+    return _AMP_POLICY is not None
+
+
+@contextlib.contextmanager
+def amp_scope(policy):
+    """Activate ``policy`` (an :class:`~mxnet_tpu.precision.config.
+    AmpPolicy`) for the ops dispatched inside the block."""
+    global _AMP_POLICY
+    prev = _AMP_POLICY
+    _AMP_POLICY = policy
+    try:
+        yield
+    finally:
+        _AMP_POLICY = prev
+
+
+def cast_inputs(op_name: str, inputs):
+    """Apply the active cast policy to one op call's NDArray inputs.
+
+    Called from ``ops.registry._invoke_impl`` ONLY when a policy is
+    active (the registry checks the module global first, so the AMP-off
+    dispatch path is byte-for-byte unchanged).  Casts are real ops and
+    inline into whatever trace is running — that is the graph-level
+    pass: the cast decisions are properties of the traced program, not
+    of eager per-call wrappers."""
+    policy = _AMP_POLICY
+    cls = policy.op_class(op_name)
+    if cls is None:
+        return inputs
+    import numpy as np
+
+    low = np.dtype(policy.dtype)
+    f32 = np.dtype(np.float32)
+    if cls == "low":
+        src, dst = f32, policy.dtype
+    else:  # widen
+        src, dst = low, "float32"
+    out = list(inputs)
+    changed = False
+    for i, x in enumerate(out):
+        if np.dtype(x.dtype) == src:
+            out[i] = x.astype(dst)
+            changed = True
+    return out if changed else inputs
+
+
+@contextlib.contextmanager
+def quant_scope(mapping):
+    """Activate a {id(layer): int8-twin} mapping for the layers called
+    inside the block (the serving adapter's traced decode/prefill)."""
+    global _QUANT_MAP
+    prev = _QUANT_MAP
+    _QUANT_MAP = mapping
+    try:
+        yield
+    finally:
+        _QUANT_MAP = prev
+
+
+def quant_entry(layer):
+    """The active int8 twin for ``layer``, or None (the single check the
+    gluon Dense/Conv forward pays; one global read when quantization is
+    off)."""
+    m = _QUANT_MAP
+    if m is None:
+        return None
+    return m.get(id(layer))

@@ -317,6 +317,11 @@ class ServingEngine:
         from ..ndarray import zeros as nd_zeros
 
         self._adapter = adapter
+        # precision label of the compiled decode program (fp32, or int8
+        # for a precision.QuantizedAdapter) — rides on the mx_serve_*
+        # telemetry so dashboards can attribute latency/throughput to
+        # the dtype program serving them (docs/PRECISION.md)
+        self._precision = str(getattr(adapter, "precision", "fp32"))
         self._ctx = ctx if ctx is not None else current_context()
         self._S = slots if slots is not None else env_int("MX_SERVE_SLOTS", 8)
         self._ps = page_size if page_size is not None \
@@ -466,7 +471,8 @@ class ServingEngine:
                                       active_slots=len(burst_ids),
                                       queue_depth=self._sched.depth)
             telemetry.record_serve_state(queue_depth=self._sched.depth,
-                                         active_slots=active)
+                                         active_slots=active,
+                                         precision=self._precision)
             guard += burst
             if guard > max_steps:
                 raise MXNetError(f"serving run exceeded {max_steps} decode "
@@ -832,5 +838,6 @@ class ServingEngine:
             decode_ms=round(decode_ms, 3), tokens=len(req.stream),
             ttft_ms=round(req.ttft_ms, 3),
             total_ms=round(total_ms, 3) if total_ms is not None else None,
-            request_id=req.id, reason=req.stream.finish_reason)
+            request_id=req.id, reason=req.stream.finish_reason,
+            precision=self._precision)
         self._slots[slot] = None

@@ -169,7 +169,10 @@ class _State:
                       "lat_ms": deque(maxlen=512),
                       "ttft_ms": deque(maxlen=512),
                       "slo_ttft": 0, "slo_tpot": 0,
-                      "queue_depth": 0, "active_slots": 0}
+                      "queue_depth": 0, "active_slots": 0,
+                      # precision label of the serving engine's compiled
+                      # decode program (fp32 / int8 — docs/PRECISION.md)
+                      "precision": "fp32"}
         # newest in-flight dispatch-window depth any executor reported
         # (record_step's inflight_depth field) — a /healthz input
         self.inflight_depth = 0
@@ -651,15 +654,21 @@ def record_serve_request(queue_wait_ms: float = 0.0,
                request_id=fields.get("request_id"))
 
 
-def record_serve_state(queue_depth: int, active_slots: int) -> None:
+def record_serve_state(queue_depth: int, active_slots: int,
+                       precision: Optional[str] = None) -> None:
     """Queue-depth / active-slot gauges, stamped by the serving engine
     at every stream boundary (aggregate-only: no per-boundary event —
-    one boundary per few decode steps would drown the flight ring)."""
+    one boundary per few decode steps would drown the flight ring).
+    ``precision`` labels which dtype program is serving (fp32/int8 —
+    surfaces as ``mx_serve_precision_info`` and in
+    ``summary()['serving']``)."""
     if not _state.enabled:
         return
     with _state.lock:
         _state.serve["queue_depth"] = int(queue_depth)
         _state.serve["active_slots"] = int(active_slots)
+        if precision is not None:
+            _state.serve["precision"] = str(precision)
 
 
 def _percentile(sorted_vals, q: float) -> float:
@@ -840,6 +849,7 @@ def _serving_rollup() -> dict:
         "slo_violations": {"ttft": sv["slo_ttft"], "tpot": sv["slo_tpot"]},
         "queue_depth": sv["queue_depth"],
         "active_slots": sv["active_slots"],
+        "precision": sv.get("precision", "fp32"),
     }
 
 
@@ -1249,6 +1259,12 @@ def render_prometheus(mode: str = "live") -> str:
                 f'stage="{stage}"}} {sv["slo_violations"][stage]}')
         gauge("mx_serve_queue_depth", sv["queue_depth"])
         gauge("mx_serve_active_slots", sv["active_slots"])
+        # info-style precision label (a NEW gauge, not a new label on
+        # the existing series — label-set changes break scrapers)
+        lines.append("# TYPE mx_serve_precision_info gauge")
+        lines.append(
+            f'mx_serve_precision_info{{{rank_lbl},'
+            f'precision="{_prom_escape(sv.get("precision", "fp32"))}"}} 1')
     per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
     per_key("mx_span_ms_total", s["spans"], "total_ms", "span",
             kind="counter")

@@ -309,6 +309,94 @@ def test_superstep_entries_registered_and_rename_fails_loudly(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# precision subsystem entries (ISSUE 15): the loss-scale shim and the
+# int8 decode body are hot paths; the OLD per-gradient readback pattern
+# must be flagged if ever reintroduced
+# ---------------------------------------------------------------------------
+def test_precision_entries_registered():
+    assert mxlint.HOT_PATH_ENTRIES["mxnet_tpu/precision/loss_scale.py"] \
+        == ("overflow_flag",)
+    assert mxlint.HOT_PATH_ENTRIES["mxnet_tpu/precision/quantize.py"] \
+        == ("QuantizedAdapter.decode",)
+    amp_entries = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/contrib/amp/amp.py"]
+    assert "DynamicLossScaler.has_overflow" in amp_entries
+
+
+def test_old_scaler_readback_pattern_would_be_flagged(tmp_path):
+    """The pre-PR-15 DynamicLossScaler.has_overflow body — one blocking
+    asnumpy() PER GRADIENT inside the per-step path — fires hot-sync
+    under the entry now registered for the shim.  Reintroducing the old
+    pattern cannot land silently."""
+    entries = {"mxnet_tpu/fixture.py": ("DynamicLossScaler.has_overflow",)}
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class DynamicLossScaler:
+            def has_overflow(self, params):
+                for param in params:
+                    for g in param.list_grad():
+                        arr = g.asnumpy()
+                        if not np.isfinite(arr).all():
+                            return True
+                return False
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+    assert ".asnumpy()" in findings[0].message
+
+
+def test_new_scaler_shim_shape_is_clean(tmp_path):
+    """The fused-delegate shim shape — collect raw grad buffers, ONE
+    fused device reduce, one justified boundary readback — lints clean
+    under the same entry."""
+    entries = {"mxnet_tpu/fixture.py": ("DynamicLossScaler.has_overflow",)}
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        def overflow_flag(arrays):
+            return arrays
+
+        class DynamicLossScaler:
+            def has_overflow(self, params):
+                grads = [g._data for p in params for g in p.list_grad()]
+                if not grads:
+                    return False
+                flag = overflow_flag(grads)
+                # mxlint: disable=hot-sync — ONE readback at the eager
+                # python-bool API boundary
+                return bool(np.asarray(flag))
+        """, hot_entries=entries)
+    assert rules_of(findings) == []
+
+
+def test_quantized_decode_body_guarded(tmp_path):
+    """A host readback sneaking into the int8 adapter's decode body (the
+    trace body of the ONE quantized executable) is flagged."""
+    entries = {"mxnet_tpu/fixture.py": ("QuantizedAdapter.decode",)}
+    findings, _ = lint_src(tmp_path, """
+        class QuantizedAdapter:
+            def decode(self, F, tok):
+                return float(tok.sum())
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+    findings, _ = lint_src(tmp_path, """
+        class QuantizedAdapter:
+            def decode(self, F, tok):
+                return self._inner.decode(F, tok)
+        """, hot_entries=entries)
+    assert rules_of(findings) == []
+
+
+def test_precision_entry_rename_fails_loudly(tmp_path):
+    entries = {"mxnet_tpu/fixture.py": ("overflow_flag",)}
+    findings, _ = lint_src(tmp_path, """
+        def overflow_flag_renamed(arrays):
+            return arrays
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["stale-hot-entry"]
+    assert "overflow_flag" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
 # signal-unsafe
 # ---------------------------------------------------------------------------
 def test_signal_unsafe_import_open_acquire_flagged(tmp_path):

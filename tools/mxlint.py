@@ -97,6 +97,19 @@ HOT_PATH_ENTRIES = {
         # it — a host sync here would stall every strategy at once
         "DataParallelStep._plan_dispatch"),
     "mxnet_tpu/optimizer/fused.py": ("FusedUpdater._apply_impl",),
+    # precision subsystem (docs/PRECISION.md): the fused overflow reduce
+    # the eager loss-scale shim dispatches per step, and the int8
+    # adapter's decode body (the trace body of the ONE quantized decode
+    # executable — a host sync here would land inside engine tracing or
+    # stall the serving pipeline)
+    "mxnet_tpu/precision/loss_scale.py": ("overflow_flag",),
+    "mxnet_tpu/precision/quantize.py": ("QuantizedAdapter.decode",),
+    # the eager AMP compatibility shim: scale_loss/has_overflow run per
+    # Trainer step — the PR 15 fix replaced its per-gradient asnumpy()
+    # loop with ONE fused device reduce; these entries keep the old
+    # readback pattern from creeping back in
+    "mxnet_tpu/contrib/amp/amp.py": ("DynamicLossScaler.has_overflow",
+                                     "unscale"),
     "mxnet_tpu/parallel/async_loss.py": (
         "InflightRing.make_room", "InflightRing.admit",
         "InflightRing.discard"),
