@@ -17,6 +17,15 @@ the writer so every one of those failure shapes is reproducible on demand
 
 Includes the RNG key (the reference's noted gap: "RNG state NOT
 checkpointed") so a restored run continues the exact sample sequence.
+
+Shard-granular format (format 2, ``MX_CKPT_SHARDED`` or
+``AsyncCheckpointer(sharded=True)``): every rank writes ONLY its
+locally-addressable shards (``params-shard-R.nd`` / ``optstate-shard-R.nd``
+plus an atomic ``shard-R.json`` digest marker), and ``meta.json`` carries a
+rank-invariant shard manifest next to ``layout`` — ZERO collectives on the
+save path, so scheduled saves never gang-lockstep an allgather and the
+SIGTERM preemption path can snapshot cross-process-sharded state
+rank-locally (docs/FAULT_TOLERANCE.md §Shard-granular checkpoints).
 """
 from __future__ import annotations
 
@@ -41,6 +50,25 @@ __all__ = ["AsyncCheckpointer", "load_checkpoint_state", "restore",
            "latest_valid_step", "agree_resume_step"]
 
 _LOG = logging.getLogger("mxnet_tpu.checkpoint")
+
+
+def _env_sharded_default() -> bool:
+    """``MX_CKPT_SHARDED`` (off unless truthy): the constructor default
+    for shard-granular (format 2) checkpoints."""
+    return os.environ.get("MX_CKPT_SHARDED", "").lower() not in (
+        "", "0", "false", "off")
+
+
+def _shard_wait_s() -> float:
+    """How long the leader rank waits for peer shard commit markers
+    before publishing a (possibly incomplete) step
+    (``MX_CKPT_SHARD_WAIT_S``, seconds).  An incomplete publish is not a
+    corruption: validation rejects it and restore falls back to the
+    previous step."""
+    try:
+        return float(os.environ.get("MX_CKPT_SHARD_WAIT_S", "60"))
+    except (TypeError, ValueError):
+        return 60.0
 
 
 def _is_step_target(obj) -> bool:
@@ -117,16 +145,29 @@ class AsyncCheckpointer:
     must stay lockstep across the gang) but never persisted or pruned —
     without this, N ranks racing rename-into-place on shared storage
     would tear each other's publishes.
+
+    ``sharded=True`` (default from ``MX_CKPT_SHARDED``) switches a
+    DataParallelStep target to the shard-granular format: EVERY rank —
+    ``writer=False`` included — persists the shards it owns
+    (``writer=False`` narrows to "does not publish meta/latest or
+    rotate"), with zero collectives on the save path.  The leader waits
+    up to ``MX_CKPT_SHARD_WAIT_S`` for peer commit markers before
+    publishing; a step missing a peer's shards simply fails validation
+    and restore falls back.  Non-step targets (a Gluon Block) ignore the
+    flag — their snapshots are host-replicated already.
     """
 
     def __init__(self, directory: str, save_every: int = 100, keep: int = 2,
-                 initial_step: Optional[int] = None, writer: bool = True):
+                 initial_step: Optional[int] = None, writer: bool = True,
+                 sharded: Optional[bool] = None):
         if save_every < 1:
             raise MXNetError("save_every must be >= 1")
         self.dir = directory
         self.save_every = save_every
         self.keep = keep
         self.writer = bool(writer)
+        self.sharded = (_env_sharded_default() if sharded is None
+                        else bool(sharded))
         os.makedirs(directory, exist_ok=True)
         if initial_step is None:
             # continue numbering from the newest step on disk; a torn
@@ -174,7 +215,9 @@ class AsyncCheckpointer:
         # category (host bytes — the params were copied off device)
         memwatch.register("checkpoint", self, _queued_snapshot_arrays)
         self._writer = None
-        if self.writer:
+        if self.writer or self.sharded:
+            # sharded mode: every rank persists its own shard files, so
+            # writer=False peers run the background thread too
             self._writer = threading.Thread(target=self._writer_loop,
                                             daemon=True)
             self._writer.start()
@@ -197,6 +240,12 @@ class AsyncCheckpointer:
         memwatch.on_step(self._step)
         if self._step % self.save_every != 0:
             return False
+        if self.sharded and hasattr(params, "shard_state_dict"):
+            # shard-granular: EVERY rank (writer or not) snapshots and
+            # persists exactly the shards it owns — no collective, no
+            # full-state D2H sweep on any rank
+            self._queue.put(self._sharded_snap(params, trainer, extra))
+            return True
         if not self.writer:
             # non-writer rank of a shared-dir gang: participate in the
             # snapshot ONLY when it runs a lockstep collective (a
@@ -265,24 +314,42 @@ class AsyncCheckpointer:
         same-step double publish is two snapshots of identical logical
         state, and validation tolerates a racy `latest`.
 
-        Non-writer ranks (shared-dir gangs) return 0 without snapshotting:
-        SIGTERM is rank-local, so a collective gather here could never be
-        assumed lockstep — and the writer rank's own preemption save
-        covers the gang."""
-        if self._step == 0 or not self.writer:
+        Shard-granular mode (``sharded=True``, or AUTOMATICALLY whenever
+        the target's state is cross-process-sharded): the snapshot writes
+        rank-local shard files with zero collectives, so this path no
+        longer raises on TP/SP-sharded state — and non-writer ranks
+        persist their own shards too (whole-gang preemption completes the
+        checkpoint; a rank-local SIGTERM leaves an incomplete step that
+        validation rejects and restore falls back past).  Gathered mode
+        keeps the old contract: non-writer ranks return 0 without
+        snapshotting (SIGTERM is rank-local, a collective gather here
+        could never be assumed lockstep)."""
+        if self._step == 0:
             return 0
-        host_params, opt, layout = _snapshot_target(params,
-                                                    allow_collective=False)
-        snap = {
-            "step": self._step,
-            "params": host_params,
-            "opt": opt,
-            "layout": layout,
-            "trainer": (self._trainer_states(trainer)
-                        if trainer is not None else None),
-            "rng": self._rng_state(),
-            "extra": extra or {},
-        }
+        needs = getattr(params, "snapshot_requires_collective", None)
+        use_sharded = hasattr(params, "shard_state_dict") and (
+            self.sharded or (needs is not None and needs()))
+        if not self.writer and not use_sharded:
+            return 0
+        if use_sharded:
+            snap = self._sharded_snap(params, trainer, extra)
+            # a SIGTERM handler cannot sit out the full peer-marker wait
+            # (the supervisor's kill window is short); an incomplete
+            # publish is rejected by validation, never mis-restored
+            snap["wait_s"] = 2.0
+        else:
+            host_params, opt, layout = _snapshot_target(
+                params, allow_collective=False)
+            snap = {
+                "step": self._step,
+                "params": host_params,
+                "opt": opt,
+                "layout": layout,
+                "trainer": (self._trainer_states(trainer)
+                            if trainer is not None else None),
+                "rng": self._rng_state(),
+                "extra": extra or {},
+            }
         deadline = time.monotonic() + drain_timeout
         while self._queue.unfinished_tasks and time.monotonic() < deadline:
             time.sleep(0.01)
@@ -295,6 +362,23 @@ class AsyncCheckpointer:
         return self._step
 
     # ------------------------------------------------------------------
+    def _sharded_snap(self, params, trainer, extra) -> dict:
+        """Rank-local shard snapshot dict for the writer queue: the
+        target's ``shard_state_dict`` (zero collectives) plus the
+        save-time layout; trainer states ride with the leader only."""
+        state = params.shard_state_dict()
+        layout = params.layout()
+        layout["optimizer"] = state.get("optimizer")
+        return {
+            "step": self._step,
+            "sharded": state,
+            "layout": layout,
+            "trainer": (self._trainer_states(trainer)
+                        if trainer is not None and self.writer else None),
+            "rng": self._rng_state(),
+            "extra": extra or {},
+        }
+
     @staticmethod
     def _rng_state():
         from . import random as mx_random
@@ -336,6 +420,8 @@ class AsyncCheckpointer:
         memwatch.on_checkpoint("save", snap["step"])
 
     def _write_impl(self, snap):
+        if "sharded" in snap:
+            return self._write_sharded_impl(snap)
         from .ndarray import utils as nd_utils
         from . import ndarray as nd
 
@@ -386,6 +472,20 @@ class AsyncCheckpointer:
             meta["world_size"] = snap["layout"].get("world_size")
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
+        self._publish(step, tmp, final)
+        if telemetry.enabled():
+            try:
+                nbytes = sum(os.path.getsize(os.path.join(final, f))
+                             for f in os.listdir(final))
+            except OSError:
+                nbytes = 0
+            telemetry.record_checkpoint(
+                "save", step=step, wall_s=time.perf_counter() - t0,
+                nbytes=nbytes)
+        fault.on_write_published(step, final)
+
+    def _publish(self, step, tmp, final):
+        """Atomically publish a complete staging dir and rotate."""
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
@@ -414,15 +514,108 @@ class AsyncCheckpointer:
         for old in drop:
             shutil.rmtree(os.path.join(self.dir, f"step-{old}"),
                           ignore_errors=True)
+
+    def _write_sharded_impl(self, snap):
+        """Format-2 write: this rank persists ONLY the shards it owns
+        into a gang-shared fixed-name staging dir, committing them with
+        an atomic per-rank ``shard-R.json`` digest marker.  The leader
+        (``writer=True``) additionally waits for peer markers, writes
+        ``meta.json`` (format, manifest, layout, rng) and publishes.
+        No collective anywhere: cross-rank coordination is filesystem
+        polling against a bounded deadline, and a timeout publishes an
+        incomplete step that validation simply rejects."""
+        from .ndarray import utils as nd_utils
+        from . import ndarray as nd
+
+        step = snap["step"]
+        state = snap["sharded"]
+        rank = int(state["rank"])
+        t0 = time.perf_counter()
+        fault.on_write_begin(step)
+        # FIXED-name staging dir shared by the whole gang (unlike the
+        # gathered path's thread-unique tmp): every rank must agree on
+        # where step N stages.  Per-file writes stay private until the
+        # rank's marker commits them.
+        tmp = os.path.join(self.dir, f".tmp-{step}-shard")
+        final = os.path.join(self.dir, f"step-{step}")
+        os.makedirs(tmp, exist_ok=True)
+        digests = {}
+
+        def dump(fname, section):
+            arrs = {}
+            for sname, payloads in section.items():
+                for j, a in payloads:
+                    arrs[f"{sname}#{j}"] = nd.array(a, dtype=a.dtype)
+            if not arrs:
+                return
+            path = os.path.join(tmp, fname)
+            nd_utils.save(path, arrs)
+            digests[fname] = _sha256_file(path)
+
+        dump(f"params-shard-{rank}.nd", state["params"])
+        dump(f"optstate-shard-{rank}.nd", state["opt_state"])
+        nbytes_local = sum(
+            os.path.getsize(os.path.join(tmp, f)) for f in digests)
+        # the rank's commit marker, written LAST and atomically: its
+        # presence means "rank R's shard files are complete", and its
+        # digests are what load-time validation verifies
+        marker = {"rank": rank, "step": step, "digests": digests}
+        mpath = os.path.join(tmp, f"shard-{rank}.json")
+        mtmp = f"{mpath}.tmp-{threading.get_ident()}"
+        with open(mtmp, "w") as f:
+            json.dump(marker, f)
+        os.replace(mtmp, mpath)
+        if not self.writer:
+            # peer rank: shards committed, the leader publishes.  nbytes
+            # is LOCAL shard bytes — the zero-collective scaling signal
+            # (per-rank save cost tracks per-rank shard bytes, not
+            # global param bytes)
+            if telemetry.enabled():
+                telemetry.record_checkpoint(
+                    "save", step=step, wall_s=time.perf_counter() - t0,
+                    nbytes=nbytes_local, sharded=True, rank=rank)
+            return
+        meta_digests = {}
+        if snap["trainer"] is not None:
+            with open(os.path.join(tmp, "trainer.states"), "wb") as f:
+                f.write(snap["trainer"])
+            meta_digests["trainer.states"] = _sha256_file(
+                os.path.join(tmp, "trainer.states"))
+        fault.on_write_mid(step)
+        manifest = state["manifest"]
+        peers = _manifest_ranks(manifest) - {rank}
+        deadline = time.monotonic() + snap.get("wait_s", _shard_wait_s())
+        missing = []
+        while True:
+            missing = [r for r in sorted(peers) if not os.path.exists(
+                os.path.join(tmp, f"shard-{r}.json"))]
+            if not missing or time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        if missing:
+            _LOG.warning(
+                "sharded checkpoint step %d: no commit marker from "
+                "rank(s) %s within the wait window — publishing anyway "
+                "(the step will fail validation and restore falls back)",
+                step, missing)
+        meta = {"step": step, "format": 2, "rng": snap["rng"],
+                "extra": snap["extra"], "digests": meta_digests,
+                "manifest": manifest,
+                "layout": snap["layout"],
+                "world_size": (snap["layout"] or {}).get("world_size")}
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        self._publish(step, tmp, final)
         if telemetry.enabled():
             try:
-                nbytes = sum(os.path.getsize(os.path.join(final, f))
-                             for f in os.listdir(final))
+                total = sum(os.path.getsize(os.path.join(final, f))
+                            for f in os.listdir(final))
             except OSError:
-                nbytes = 0
+                total = 0
             telemetry.record_checkpoint(
                 "save", step=step, wall_s=time.perf_counter() - t0,
-                nbytes=nbytes)
+                nbytes=nbytes_local, sharded=True, rank=rank,
+                total_nbytes=total)
         fault.on_write_published(step, final)
 
 
@@ -437,7 +630,99 @@ def _queued_snapshot_arrays(ckpt):
     for snap in items:
         if isinstance(snap, dict):
             out.extend(snap.get("params", {}).values())
+            sharded = snap.get("sharded")
+            if sharded:
+                for payloads in sharded.get("params", {}).values():
+                    out.extend(a for _, a in payloads)
+                for payloads in sharded.get("opt_state", {}).values():
+                    out.extend(a for _, a in payloads)
     return out
+
+
+def _manifest_ranks(manifest: dict) -> set:
+    """Every rank the manifest says owns at least one shard — the set
+    whose shard files + commit markers a valid format-2 step must hold."""
+    ranks = set()
+    for section in ("params", "opt_state"):
+        for ent in (manifest.get(section) or {}).values():
+            for sh in ent.get("shards", []):
+                ranks.add(int(sh["rank"]))
+    return ranks
+
+
+class _ShardReader:
+    """Per-rank shard-file cache over one format-2 checkpoint dir: loads
+    ``params-shard-R.nd`` / ``optstate-shard-R.nd`` at most once each,
+    and only when some :class:`_LazyShardedArray` actually reads a slice
+    a shard of that rank covers."""
+
+    _PREFIX = {"params": "params-shard", "opt_state": "optstate-shard"}
+
+    def __init__(self, directory: str, meta: dict):
+        self.dir = directory
+        self.manifest = meta.get("manifest") or {}
+        self._files: Dict[tuple, dict] = {}
+
+    def rank_file(self, section: str, rank: int) -> dict:
+        key = (section, rank)
+        if key not in self._files:
+            from .ndarray import utils as nd_utils
+
+            self._files[key] = nd_utils.load(os.path.join(
+                self.dir, f"{self._PREFIX[section]}-{rank}.nd"))
+        return self._files[key]
+
+    def section(self, section: str) -> dict:
+        return {name: _LazyShardedArray(self, section, name, ent)
+                for name, ent in (self.manifest.get(section) or {}).items()}
+
+
+class _LazyShardedArray:
+    """One logical array of a shard-granular checkpoint, readable by
+    GLOBAL slice without ever composing the full value: ``read_slice``
+    copies only the manifest shards that intersect the request — what
+    ``_lazy_put`` feeds ``jax.make_array_from_callback`` so an N->M
+    elastic restore moves per-device shard bytes, not whole arrays.
+    ``asnumpy()``/``__array__`` compose the full array for the legacy
+    (host-gathered) consumers — small single-host cases only."""
+
+    def __init__(self, reader: _ShardReader, section: str, name: str,
+                 ent: dict):
+        self._reader = reader
+        self._section = section
+        self.name = name
+        self.shape = tuple(int(s) for s in ent["shape"])
+        self.dtype = np.dtype(ent["dtype"])
+        self._shards = ent["shards"]
+
+    def read_slice(self, idx) -> np.ndarray:
+        want = []
+        for dim, s in enumerate(idx):
+            start = 0 if s.start is None else int(s.start)
+            stop = (self.shape[dim] if s.stop is None else int(s.stop))
+            want.append((start, stop))
+        out = np.empty(tuple(b - a for a, b in want), self.dtype)
+        for sh in self._shards:
+            src = [tuple(int(x) for x in p) for p in sh["slice"]]
+            inter = [(max(a, c), min(b, d))
+                     for (a, b), (c, d) in zip(want, src)]
+            if any(a >= b for a, b in inter):
+                continue
+            data = self._reader.rank_file(self._section, int(sh["rank"]))[
+                f"{self.name}#{int(sh['j'])}"].asnumpy()
+            dst = tuple(slice(a - w, b - w)
+                        for (a, b), (w, _) in zip(inter, want))
+            sel = tuple(slice(a - s0, b - s0)
+                        for (a, b), (s0, _) in zip(inter, src))
+            out[dst] = data[sel]
+        return out
+
+    def asnumpy(self) -> np.ndarray:
+        return self.read_slice(tuple(slice(0, s) for s in self.shape))
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
 
 
 def _sha256_file(path: str) -> str:
@@ -481,6 +766,8 @@ def _read_meta_if_valid(d: str):
         return None
     if not isinstance(meta, dict) or "step" not in meta:
         return None
+    if int(meta.get("format", 1)) >= 2:
+        return meta if _shard_files_valid(d, meta) else None
     digests = meta.get("digests")
     if digests is None:
         # pre-digest checkpoint (older layout): existence check only
@@ -492,6 +779,44 @@ def _read_meta_if_valid(d: str):
         except OSError:
             return None
     return meta
+
+
+def _shard_files_valid(d: str, meta: dict) -> bool:
+    """Format-2 validity: every meta-level digest (trainer.states)
+    verifies, every shard-owning rank's commit marker parses, the
+    marker's digests verify, and each rank that the manifest assigns
+    shards to actually committed the corresponding shard file.  A torn
+    write, a missing peer (leader published on wait timeout), or a
+    corrupted single shard all fail HERE — so restore's existing
+    next-newest-step fallback covers them."""
+    for fname, want in (meta.get("digests") or {}).items():
+        try:
+            if _sha256_file(os.path.join(d, fname)) != want:
+                return False
+        except OSError:
+            return False
+    manifest = meta.get("manifest") or {}
+    for r in sorted(_manifest_ranks(manifest)):
+        try:
+            with open(os.path.join(d, f"shard-{r}.json")) as f:
+                marker = json.load(f)
+        except (OSError, ValueError):
+            return False
+        digests = marker.get("digests") or {}
+        for section, prefix in (("params", "params-shard"),
+                                ("opt_state", "optstate-shard")):
+            owns = any(
+                any(int(sh["rank"]) == r for sh in ent.get("shards", []))
+                for ent in (manifest.get(section) or {}).values())
+            if owns and f"{prefix}-{r}.nd" not in digests:
+                return False
+        for fname, want in digests.items():
+            try:
+                if _sha256_file(os.path.join(d, fname)) != want:
+                    return False
+            except OSError:
+                return False
+    return True
 
 
 def latest_valid_step(directory: str,
@@ -575,6 +900,33 @@ def _load_checkpoint_state(directory: str, step: Optional[int] = None):
             telemetry.record_checkpoint("fallback", step=s,
                                         reason="digest-or-meta")
             continue
+        if int(meta.get("format", 1)) >= 2:
+            # shard-granular checkpoint: hand back LAZY per-array views
+            # over the shard files — consumers that can place per-shard
+            # (DataParallelStep.load_state_dict) never compose a full
+            # array on this host; legacy consumers call .asnumpy()
+            reader = _ShardReader(d, meta)
+            params = reader.section("params")
+            opt_state = reader.section("opt_state") or None
+            trainer_states = None
+            tpath = os.path.join(d, "trainer.states")
+            if os.path.exists(tpath):
+                with open(tpath, "rb") as f:
+                    trainer_states = f.read()
+            if meta.get("rng") is not None:
+                import jax.numpy as jnp
+
+                from . import random as mx_random
+
+                mx_random._state.key = jnp.asarray(
+                    np.asarray(meta["rng"], np.uint32))
+            telemetry.record_checkpoint(
+                "load", step=s, wall_s=time.perf_counter() - t0,
+                sharded=True)
+            return {"step": s, "params": params, "opt_state": opt_state,
+                    "trainer": trainer_states,
+                    "extra": meta.get("extra", {}),
+                    "layout": meta.get("layout")}
         try:
             params = nd_utils.load(os.path.join(d, "params.nd"))
         except Exception as e:  # undecodable payload (pre-digest torn file)
@@ -640,9 +992,14 @@ def restore(directory: str, net, trainer=None,
     if state is None:
         return 0
     if _is_step_target(net):
-        host = {"params": {k: v.asnumpy()
+        # lazy shard views pass through untouched: load_state_dict
+        # places them per-shard (never composing the full array);
+        # eager NDArrays from gathered checkpoints read to host here
+        host = {"params": {k: (v if hasattr(v, "read_slice")
+                               else v.asnumpy())
                            for k, v in state["params"].items()},
-                "opt_state": {k: v.asnumpy()
+                "opt_state": {k: (v if hasattr(v, "read_slice")
+                                  else v.asnumpy())
                               for k, v in (state["opt_state"] or {}).items()}}
         net.load_state_dict(host, saved_layout=state.get("layout"))
         return state["step"]

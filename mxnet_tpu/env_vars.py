@@ -86,8 +86,18 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
     # with no reference counterpart
     "MX_FAULT_SPEC": (
         "honored", "fault-injection harness: crash / crash-write / "
-        "torn-write / slow-write specs with rank=/if-restart= qualifiers "
-        "(fault.py, hooks in checkpoint.py)"),
+        "torn-write / slow-write specs with rank=/shard=/if-restart= "
+        "qualifiers (fault.py, hooks in checkpoint.py; torn-write:shard=R "
+        "corrupts one rank's shard file of a sharded checkpoint)"),
+    "MX_CKPT_SHARDED": (
+        "honored", "default AsyncCheckpointer(sharded=) — shard-granular "
+        "(format 2) checkpoints: every rank writes only its own shards, "
+        "zero collectives on the save path (checkpoint.py, "
+        "docs/FAULT_TOLERANCE.md §Shard-granular checkpoints)"),
+    "MX_CKPT_SHARD_WAIT_S": (
+        "honored", "seconds the leader rank waits for peer shard commit "
+        "markers before publishing a sharded checkpoint step (default 60; "
+        "the preemption save_now path caps it at 2s) (checkpoint.py)"),
     "MX_RENDEZVOUS_TIMEOUT": (
         "honored", "seconds a (re)started rank retries "
         "jax.distributed.initialize with backoff (parallel/dist.py)"),

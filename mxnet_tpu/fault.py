@@ -14,8 +14,8 @@ Fault spec grammar (``MX_FAULT_SPEC``, ';'-separated specs)::
     spec       := kind (":" key "=" value)*
     kind       := "crash" | "crash-write" | "torn-write" | "slow-write"
                 | "oom" | "crash-rendezvous"
-    key        := "step" | "ms" | "file" | "rank" | "if-restart"
-                | "if-world"
+    key        := "step" | "ms" | "file" | "rank" | "shard"
+                | "if-restart" | "if-world"
 
   crash:step=N        hard os._exit(EXIT_INJECTED_CRASH) when the training
                       step counter reaches N (before N's checkpoint is
@@ -31,7 +31,11 @@ Fault spec grammar (``MX_FAULT_SPEC``, ';'-separated specs)::
                       ``.tmp-N`` dir is left behind (never published)
   torn-write:step=N   publish step N, then truncate its files in place —
                       the on-disk shape of a power loss between write and
-                      fsync; file=meta|params|all (default all) picks which
+                      fsync; file=meta|params|all (default all) picks which.
+                      shard=R instead corrupts ONE rank's shard file
+                      (params-shard-R.nd) of a shard-granular checkpoint —
+                      the single-torn-shard chaos shape (validation must
+                      reject the step and restore fall back)
   slow-write:ms=M     sleep M ms at the start of every checkpoint write
                       (step=N restricts it to one write)
   crash-rendezvous    die DURING the gang rendezvous (parallel/dist.py
@@ -71,18 +75,20 @@ EXIT_PREEMPTED = 83
 
 _KINDS = ("crash", "crash-write", "torn-write", "slow-write", "oom",
           "crash-rendezvous")
-_KEYS = ("step", "ms", "file", "rank", "if-restart", "if-world")
+_KEYS = ("step", "ms", "file", "rank", "shard", "if-restart",
+         "if-world")
 
 
 class Fault:
     """One parsed fault: kind + trigger qualifiers."""
 
-    __slots__ = ("kind", "step", "ms", "file", "rank", "if_restart",
-                 "if_world")
+    __slots__ = ("kind", "step", "ms", "file", "rank", "shard",
+                 "if_restart", "if_world")
 
     def __init__(self, kind: str, step: Optional[int] = None,
                  ms: Optional[int] = None, file: str = "all",
                  rank: Optional[int] = None,
+                 shard: Optional[int] = None,
                  if_restart: Optional[int] = None,
                  if_world: Optional[int] = None):
         self.kind = kind
@@ -90,6 +96,7 @@ class Fault:
         self.ms = ms
         self.file = file
         self.rank = rank
+        self.shard = shard
         self.if_restart = if_restart
         self.if_world = if_world
 
@@ -154,6 +161,11 @@ def parse_spec(text: str) -> List[Fault]:
             raise MXNetError(f"MX_FAULT_SPEC: {f.kind} requires step=N")
         if f.kind == "slow-write" and f.ms is None:
             raise MXNetError("MX_FAULT_SPEC: slow-write requires ms=N")
+        if f.shard is not None and f.kind != "torn-write":
+            raise MXNetError(
+                "MX_FAULT_SPEC: shard=R only applies to torn-write "
+                "(it selects which rank's shard file to corrupt in a "
+                "shard-granular checkpoint)")
         if f.kind == "crash-rendezvous" and f.step is not None:
             raise MXNetError(
                 "MX_FAULT_SPEC: crash-rendezvous fires at rendezvous time, "
@@ -251,8 +263,14 @@ def on_write_published(step: int, final_dir: str) -> None:
     f = _match("torn-write", step)
     if f is None or f.step != step:
         return
-    targets = {"meta": ["meta.json"], "params": ["params.nd"],
-               "all": ["meta.json", "params.nd"]}[f.file]
+    if f.shard is not None:
+        # shard-granular checkpoints: corrupt exactly rank R's shard
+        # file — the single-shard-corruption chaos shape (the whole step
+        # must fail validation and restore fall back past it)
+        targets = [f"params-shard-{f.shard}.nd"]
+    else:
+        targets = {"meta": ["meta.json"], "params": ["params.nd"],
+                   "all": ["meta.json", "params.nd"]}[f.file]
     for fname in targets:
         path = os.path.join(final_dir, fname)
         if not os.path.exists(path):

@@ -33,7 +33,10 @@ a sync; enforced by mxlint's jax-free reachability check on this file):
   ``/statusz``   the summary JSON + memwatch summary + the
                  flight-recorder tail — the "what was this rank doing"
                  one-shot for humans and for the supervisor's
-                 pre-teardown snapshot.
+                 pre-teardown snapshot.  The serving block includes the
+                 weight hot-swap generation/counters
+                 (``summary()['serving']['weight_generation']`` —
+                 docs/SERVING.md §Weight hot-swap).
 
 The server binds ``MX_METRICS_HOST`` (default ``127.0.0.1``; set
 ``0.0.0.0`` to expose it to a cross-host scraper) and runs on daemon

@@ -126,6 +126,90 @@ def _maybe_put(arr, sharding):
     return _global_put(arr, sharding), False
 
 
+def _shard_index_key(idx, shape) -> tuple:
+    """Canonical hashable key for one shard's global index: a tuple of
+    ``(start, stop)`` per dimension with the open-ended slices jax hands
+    back (``slice(None)``) normalized against the array shape, so the
+    same shard is the same key no matter which device reported it."""
+    key = []
+    for dim, s in enumerate(idx):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(shape[dim]) if s.stop is None else int(s.stop)
+        key.append((start, stop))
+    return tuple(key)
+
+
+def _local_shard_split(arr, rank: int, nprocs: int):
+    """Split one (possibly sharded) array into its deduplicated shard
+    set with a deterministic owner rank per shard — computed ENTIRELY
+    from local metadata (``devices_indices_map`` enumerates every
+    device's slice on every process), so all ranks derive the identical
+    manifest without a single collective.
+
+    Returns ``(shards, payloads)``: ``shards`` is the manifest entry
+    (``[{"rank", "j", "slice"}]``, ordered by slice), ``payloads`` the
+    ``[(j, ndarray)]`` this rank must persist (empty when it owns none).
+    Replicas dedup to one owner: the minimal ``(process_index, id)``
+    device holding the shard.  Process-local arrays (a single-device
+    scalar every rank holds its own copy of — adam's ``t``) canonicalize
+    to one rank-0 full-shape shard so the manifest stays rank-invariant."""
+    shape = tuple(int(s) for s in np.shape(arr))
+    full = tuple((0, int(s)) for s in shape)
+    if nprocs > 1 and getattr(arr, "is_fully_addressable", True):
+        shards = [{"rank": 0, "j": 0, "slice": [list(p) for p in full]}]
+        if rank != 0:
+            return shards, []
+        import jax
+
+        # mxlint: disable=hot-sync — checkpoint host snapshot
+        return shards, [(0, np.asarray(jax.device_get(arr)))]
+    if getattr(arr, "is_fully_replicated", False) or not hasattr(
+            arr, "sharding"):
+        shards = [{"rank": 0, "j": 0, "slice": [list(p) for p in full]}]
+        if rank != 0:
+            return shards, []
+        if hasattr(arr, "addressable_shards"):
+            # mxlint: disable=hot-sync — checkpoint host snapshot
+            host = np.asarray(arr.addressable_shards[0].data)
+        else:
+            host = np.asarray(arr)
+        return shards, [(0, host)]
+    owners: Dict[tuple, tuple] = {}
+    for dev, idx in arr.sharding.devices_indices_map(shape).items():
+        key = _shard_index_key(idx, shape)
+        cand = (int(dev.process_index), int(dev.id))
+        if key not in owners or cand < owners[key]:
+            owners[key] = cand
+    local = {}
+    for sh in arr.addressable_shards:
+        local.setdefault(_shard_index_key(sh.index, shape), sh)
+    shards, payloads = [], []
+    counters: Dict[int, int] = {}
+    for key in sorted(owners):
+        owner_rank = owners[key][0]
+        j = counters.get(owner_rank, 0)
+        counters[owner_rank] = j + 1
+        shards.append({"rank": owner_rank, "j": j,
+                       "slice": [list(p) for p in key]})
+        if owner_rank == rank:
+            # mxlint: disable=hot-sync — checkpoint host snapshot
+            payloads.append((j, np.asarray(local[key].data)))
+    return shards, payloads
+
+
+def _lazy_put(lazy, sharding):
+    """Place a lazily-readable sharded-checkpoint value (anything with
+    ``read_slice(idx) -> ndarray``) onto ``sharding`` WITHOUT ever
+    composing the full array on this host: the callback reads exactly
+    the slice each addressable device needs, straight out of the shard
+    files that cover it — the N->M elastic restore path at TB scale."""
+    import jax
+
+    shape = tuple(int(s) for s in lazy.shape)
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: lazy.read_slice(idx))
+
+
 def _host_scalar(loss):
     """A replicated (possibly non-fully-addressable) loss -> host scalar
     array via this process's local shard."""
@@ -1614,6 +1698,62 @@ class DataParallelStep:
         return {"params": params, "opt_state": opt,
                 "optimizer": self._optimizer}
 
+    def shard_state_dict(self) -> dict:
+        """Rank-LOCAL shard snapshot: each entry carries only the shards
+        this process's devices hold, plus the full (rank-invariant)
+        shard manifest every rank derives from metadata alone.  ZERO
+        collectives — unlike :meth:`state_dict` on cross-process-sharded
+        state, this never gathers, so it is safe on the preemption path
+        and its wall/bytes scale with the per-rank shard set, not the
+        global param count (docs/FAULT_TOLERANCE.md §Shard-granular
+        checkpoints).
+
+        Returns ``{"params": {name: [(j, ndarray)]}, "opt_state":
+        {slot: [(j, ndarray)]}, "manifest": {...}, "optimizer", "rank",
+        "nprocs"}`` — slot naming matches :meth:`state_dict`
+        (``mom.*``/``mean.*``/``var.*``/``t``/``amp.*``), so restore
+        code downstream of either format sees the same key space."""
+        if self.params is None:
+            raise MXNetError(
+                "shard_state_dict: step holds no state yet "
+                "(no step/stage ran)")
+        self.flush()
+        import jax
+
+        rank = int(jax.process_index())
+        nprocs = int(jax.process_count())
+        smap = self._struct_names()
+        manifest: Dict[str, dict] = {"params": {}, "opt_state": {}}
+        local: Dict[str, dict] = {"params": {}, "opt_state": {}}
+
+        def add(section, sname, arr):
+            shards, payloads = _local_shard_split(arr, rank, nprocs)
+            manifest[section][sname] = {
+                "shape": [int(s) for s in np.shape(arr)],
+                "dtype": str(arr.dtype),
+                "shards": shards}
+            if payloads:
+                local[section][sname] = payloads
+
+        for n, a in self.params.items():
+            add("params", smap.get(n, n), a)
+        if self._optimizer == "sgd":
+            for n, a in self.opt_state.items():
+                add("opt_state", f"mom.{smap.get(n, n)}", a)
+        else:
+            means, vars_, t = self.opt_state
+            for n, a in means.items():
+                add("opt_state", f"mean.{smap.get(n, n)}", a)
+            for n, a in vars_.items():
+                add("opt_state", f"var.{smap.get(n, n)}", a)
+            add("opt_state", "t", t)
+        if self.scaler_state is not None:
+            for k in self.scaler_state:
+                add("opt_state", f"amp.{k}", self.scaler_state[k])
+        return {"params": local["params"], "opt_state": local["opt_state"],
+                "manifest": manifest, "optimizer": self._optimizer,
+                "rank": rank, "nprocs": nprocs}
+
     def load_state_dict(self, state: dict,
                         saved_layout: Optional[dict] = None) -> dict:
         """Install a host state snapshot onto THIS step's mesh,
@@ -1664,7 +1804,18 @@ class DataParallelStep:
                 if sname not in params_host:
                     raise MXNetError(
                         f"checkpoint missing parameter {sname}")
-                host = np.asarray(params_host[sname])
+                raw = params_host[sname]
+                if hasattr(raw, "read_slice") and \
+                        not self._shardings[n].is_fully_addressable:
+                    # sharded-checkpoint lazy value onto a
+                    # cross-process-sharded target: place per-shard
+                    # straight from the shard files — NO host ever
+                    # materializes the full array (the Gluon block keeps
+                    # its init data; self.params is the authority, as it
+                    # already is for every multi-process run)
+                    new_params[n] = _lazy_put(raw, self._shardings[n])
+                    continue
+                host = np.asarray(raw)
                 new_params[n] = _global_put(host, self._shardings[n])
                 # keep the Gluon block in agreement (sync_to_block
                 # parity, and a later eager forward must see the
@@ -1689,7 +1840,7 @@ class DataParallelStep:
             def slot(prefix, n):
                 sname = f"{prefix}.{smap.get(n, n)}"
                 if sname in opt:
-                    return np.asarray(opt[sname])
+                    return opt[sname]
                 if opt:
                     # a PARTIALLY missing slot is a renamed/mismatched
                     # param, not a fresh start — zero-filling just this
@@ -1699,16 +1850,23 @@ class DataParallelStep:
                         f"{sname!r} (has: {sorted(opt)[:8]}...)")
                 return np.zeros(np.shape(new_params[n]), np.float32)
 
+            def place_slot(val, sharding):
+                # same lazy fast path as the params loop above
+                if hasattr(val, "read_slice") and \
+                        not sharding.is_fully_addressable:
+                    return _lazy_put(val, sharding)
+                return _global_put(np.asarray(val), sharding)
+
             if self._optimizer == "sgd":
                 opt_state = {
-                    n: _global_put(slot("mom", n), self._shardings[n])
+                    n: place_slot(slot("mom", n), self._shardings[n])
                     for n, _ in self._param_items}
             else:
                 import jax.numpy as jnp
 
-                m = {n: _global_put(slot("mean", n), self._shardings[n])
+                m = {n: place_slot(slot("mean", n), self._shardings[n])
                      for n, _ in self._param_items}
-                v = {n: _global_put(slot("var", n), self._shardings[n])
+                v = {n: place_slot(slot("var", n), self._shardings[n])
                      for n, _ in self._param_items}
                 t = jnp.asarray(int(np.asarray(opt.get("t", 0))),
                                 jnp.int32)

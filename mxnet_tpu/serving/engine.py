@@ -33,6 +33,7 @@ deserializes in milliseconds instead of recompiling.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -385,11 +386,25 @@ class ServingEngine:
         self._prefill_run = None
         self._prefill_names: List[str] = []
         self._pending_compile: Dict = {}
+        # zero-downtime weight hot-swap (docs/SERVING.md §Weight
+        # hot-swap): verified new weights wait in _staging until the run
+        # loop flips them in at a stream boundary
+        self._staging: Dict[str, np.ndarray] = {}
+        self._swap_pending: Optional[dict] = None
+        self._swap_lock = threading.Lock()
+        self._running = False
+        self._weight_generation = 0
         # live-array census category for the watchdog: the paged pools +
         # slot state are the serving engine's resident footprint
         memwatch.register("serving", self,
                           lambda eng: [a._data for a in
                                        eng._state.values()])
+        # the swap staging buffer is its own census category: the
+        # transient 2x-weights window shows up attributed (and the leak
+        # detector never mistakes it for growth) — it must read empty
+        # again after the flip
+        memwatch.register("staging", self,
+                          lambda eng: list(eng._staging.values()))
 
     # ------------------------------------------------------------------
     # public API
@@ -423,60 +438,191 @@ class ServingEngine:
         self.run()
         return {r.id: r.stream.asarray() for r in requests}
 
+    def swap_weights(self, ckpt_dir: str,
+                     step: Optional[int] = None) -> int:
+        """Zero-downtime weight hot-swap: load a checkpoint's params into
+        a STAGING buffer off the decode path, verify them, and flip the
+        served param pytree at the next stream boundary — in-flight
+        requests finish against a consistent weight set, the paged KV
+        pool and page tables are untouched, and because ``_params()`` is
+        re-read live each dispatch the compiled decode executable is
+        reused as-is (same AOT fingerprint = zero recompile).
+
+        Verification before anything is published: the checkpoint's
+        SHA-256 digests (``load_checkpoint_state`` rejects torn/corrupt
+        steps), full param coverage, and the decode AOT fingerprint
+        recomputed over the staged arrays — a mismatched fingerprint
+        (different shapes/dtypes, i.e. a different/quantized model) is a
+        LOUD rejection and the engine keeps serving the old weights.
+
+        Thread-safe against a concurrent :meth:`run`: the flip itself
+        only ever happens on the run-loop thread (between decode bursts)
+        or synchronously here when the engine is idle.  Returns the
+        checkpoint step swapped in; telemetry records a ``weight_swap``
+        event (staged bytes, verify/flip ms, generation) surfaced in
+        ``/statusz`` and ``mx_serve_weight_generation``."""
+        from .. import checkpoint as ckpt_mod
+
+        t0 = time.perf_counter()
+        self._ensure_compiled()
+        state = ckpt_mod.load_checkpoint_state(ckpt_dir, step=step)
+        if state is None:
+            raise MXNetError(
+                f"swap_weights: no valid checkpoint in {ckpt_dir!r} — "
+                "keeping the current weights")
+        snap = state["params"]
+        model = getattr(self._adapter, "model", None)
+        by_param = {}
+        if model is not None and hasattr(model,
+                                         "_collect_params_with_prefix"):
+            by_param = {id(p): s for s, p in
+                        model._collect_params_with_prefix().items()}
+        staging: Dict[str, np.ndarray] = {}
+        try:
+            for name, p in self._param_items:
+                sname = by_param.get(id(p), name)
+                if sname not in snap:
+                    raise MXNetError(
+                        f"swap_weights: checkpoint step {state['step']} "
+                        f"is missing parameter {sname!r} — rejected, "
+                        "keeping the current weights")
+                v = snap[sname]
+                staging[name] = (v.asnumpy() if hasattr(v, "asnumpy")
+                                 else np.asarray(v))
+            # the fingerprint gate: the decode executable's structural
+            # identity recomputed over the STAGED arrays must equal the
+            # serving one — same structure means the compiled step (and
+            # any AOT cache entry) keeps working unchanged
+            variant = ("decode", self._ps, self._S)
+            sarrs = [a._data for a in self._state.values()]
+            cur = memwatch.fingerprint(self._fingerprint_parts(
+                variant, list(self._params()) + sarrs))
+            new = memwatch.fingerprint(self._fingerprint_parts(
+                variant, [staging[n] for n, _ in self._param_items]
+                + sarrs))
+            if new != cur:
+                raise MXNetError(
+                    f"swap_weights: checkpoint step {state['step']} has "
+                    "a different decode fingerprint (param shapes/dtypes "
+                    "or adapter structure changed) — rejected, keeping "
+                    "the current weights")
+        except MXNetError as e:
+            staging.clear()
+            telemetry.record("weight_swap", executor="ServingEngine",
+                             rejected=True, reason=str(e),
+                             generation=self._weight_generation)
+            raise
+        verify_ms = (time.perf_counter() - t0) * 1e3
+        with self._swap_lock:
+            self._staging = staging
+            self._swap_pending = {
+                "step": int(state["step"]),
+                "staged_bytes": int(sum(a.nbytes
+                                        for a in staging.values())),
+                "verify_ms": verify_ms,
+            }
+        if not self._running:
+            # idle engine: no stream boundary will come around — flip now
+            self._apply_pending_swap()
+        return int(state["step"])
+
+    def _apply_pending_swap(self) -> None:
+        """Flip staged weights into the served params (stream-boundary
+        only: the run loop between bursts, or swap_weights on an idle
+        engine).  ``_params()`` reads ``p.data()`` live each dispatch, so
+        set_data IS the flip — the compiled executable never changes."""
+        with self._swap_lock:
+            pending, staging = self._swap_pending, self._staging
+            self._swap_pending = None
+            if pending is None:
+                return
+        t0 = time.perf_counter()
+        for name, p in self._param_items:
+            p.set_data(staging[name])
+        self._weight_generation += 1
+        # drain the staging census: post-flip the transient 2x-weights
+        # window is over and memwatch's "staging" category reads empty
+        self._staging = {}
+        telemetry.record_weight_swap(
+            generation=self._weight_generation,
+            staged_bytes=pending["staged_bytes"],
+            verify_ms=pending["verify_ms"],
+            flip_ms=(time.perf_counter() - t0) * 1e3,
+            step=pending["step"])
+
+    @property
+    def weight_generation(self) -> int:
+        """How many hot-swaps have been applied (0 = boot weights)."""
+        return self._weight_generation
+
     def run(self, max_steps: int = 1_000_000) -> None:
         """Drive the engine until queue, arrivals and slots are empty."""
         self._ensure_compiled()
         guard = 0
-        while True:
-            self._pump_arrivals()
-            admitted = self._admit_ready()
-            active = sum(1 for m in self._slots if m is not None)
-            if not active:
-                if self._arrivals:
-                    # idle: fast-forward the step clock to the next join
-                    self._step_n = max(self._step_n, self._arrivals[0][0])
-                    continue
-                if self._sched.depth:
-                    if not admitted:  # every slot free yet none admitted
-                        raise MXNetError(
-                            "serving queue non-empty but no request "
-                            "admissible (pool/config too small?)")
-                    continue
-                break
-            burst = self._ensure_pages(self._stream_every)
-            # request ids decoding THIS burst, captured before _consume
-            # can evict finished ones
-            burst_ids = [m.req.id for m in self._slots
-                         if m is not None and not m.done]
-            t_burst0 = time.perf_counter()
-            handles = [self._dispatch_step() for _ in range(burst)]
-            self._book_pending_compile()
-            t_stream0 = time.perf_counter()
-            self._consume(handles)
-            t_stream1 = time.perf_counter()
-            # per-request trace spans at BURST cadence, never per token
-            # (docs/OBSERVABILITY.md §Serving traces): one serve_decode
-            # span per in-flight request covering dispatch through token
-            # readback, plus one serve_stream span for the readback
-            # boundary carrying the occupancy gauges trace_report turns
-            # into the slot-occupancy timeline.  record_span is the
-            # zero-cost-when-off retroactive form — the dispatch loop
-            # above never pays for tracing.
-            if telemetry.spans_enabled():
-                for rid in burst_ids:
-                    telemetry.record_span("serve_decode", t_burst0,
-                                          t_stream1, request_id=rid,
-                                          steps=burst)
-                telemetry.record_span("serve_stream", t_stream0, t_stream1,
-                                      active_slots=len(burst_ids),
-                                      queue_depth=self._sched.depth)
-            telemetry.record_serve_state(queue_depth=self._sched.depth,
-                                         active_slots=active,
-                                         precision=self._precision)
-            guard += burst
-            if guard > max_steps:
-                raise MXNetError(f"serving run exceeded {max_steps} decode "
-                                 "steps (runaway request set?)")
+        self._running = True
+        try:
+            while True:
+                self._pump_arrivals()
+                admitted = self._admit_ready()
+                active = sum(1 for m in self._slots if m is not None)
+                if not active:
+                    if self._arrivals:
+                        # idle: fast-forward the step clock to the next
+                        # join
+                        self._step_n = max(self._step_n,
+                                           self._arrivals[0][0])
+                        continue
+                    if self._sched.depth:
+                        if not admitted:  # all slots free, none admitted
+                            raise MXNetError(
+                                "serving queue non-empty but no request "
+                                "admissible (pool/config too small?)")
+                        continue
+                    break
+                burst = self._ensure_pages(self._stream_every)
+                # request ids decoding THIS burst, captured before
+                # _consume can evict finished ones
+                burst_ids = [m.req.id for m in self._slots
+                             if m is not None and not m.done]
+                t_burst0 = time.perf_counter()
+                handles = [self._dispatch_step() for _ in range(burst)]
+                self._book_pending_compile()
+                t_stream0 = time.perf_counter()
+                self._consume(handles)
+                t_stream1 = time.perf_counter()
+                # per-request trace spans at BURST cadence, never per
+                # token (docs/OBSERVABILITY.md §Serving traces): one
+                # serve_decode span per in-flight request covering
+                # dispatch through token readback, plus one serve_stream
+                # span for the readback boundary carrying the occupancy
+                # gauges trace_report turns into the slot-occupancy
+                # timeline.  record_span is the zero-cost-when-off
+                # retroactive form — the dispatch loop above never pays
+                # for tracing.
+                if telemetry.spans_enabled():
+                    for rid in burst_ids:
+                        telemetry.record_span("serve_decode", t_burst0,
+                                              t_stream1, request_id=rid,
+                                              steps=burst)
+                    telemetry.record_span("serve_stream", t_stream0,
+                                          t_stream1,
+                                          active_slots=len(burst_ids),
+                                          queue_depth=self._sched.depth)
+                telemetry.record_serve_state(queue_depth=self._sched.depth,
+                                             active_slots=active,
+                                             precision=self._precision)
+                if self._swap_pending is not None:
+                    # the stream boundary IS the swap point: this burst's
+                    # tokens are consumed, nothing is in flight — the
+                    # next burst dispatches against the new weights
+                    self._apply_pending_swap()
+                guard += burst
+                if guard > max_steps:
+                    raise MXNetError(
+                        f"serving run exceeded {max_steps} decode "
+                        "steps (runaway request set?)")
+        finally:
+            self._running = False
         self._ring.drain()
 
     @property

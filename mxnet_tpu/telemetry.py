@@ -172,7 +172,11 @@ class _State:
                       "queue_depth": 0, "active_slots": 0,
                       # precision label of the serving engine's compiled
                       # decode program (fp32 / int8 — docs/PRECISION.md)
-                      "precision": "fp32"}
+                      "precision": "fp32",
+                      # zero-downtime hot-swap counters: which weight
+                      # generation is serving and how many swaps applied
+                      # (docs/SERVING.md §Weight hot-swap)
+                      "weight_generation": 0, "weight_swaps": 0}
         # newest in-flight dispatch-window depth any executor reported
         # (record_step's inflight_depth field) — a /healthz input
         self.inflight_depth = 0
@@ -671,6 +675,26 @@ def record_serve_state(queue_depth: int, active_slots: int,
             _state.serve["precision"] = str(precision)
 
 
+def record_weight_swap(generation: int, staged_bytes: int = 0,
+                       verify_ms: float = 0.0, flip_ms: float = 0.0,
+                       **fields) -> None:
+    """One APPLIED serving weight hot-swap (docs/SERVING.md §Weight
+    hot-swap): bumps the swap counter, publishes the new generation
+    gauge (``mx_serve_weight_generation``) and records a ``weight_swap``
+    event carrying staged bytes plus verify/flip wall.  Rejected swaps
+    record a plain ``weight_swap`` event with ``rejected=True`` at the
+    call site instead — they never advance the generation."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        _state.serve["weight_generation"] = int(generation)
+        _state.serve["weight_swaps"] += 1
+    record("weight_swap", generation=int(generation),
+           staged_bytes=int(staged_bytes),
+           verify_ms=round(float(verify_ms), 3),
+           flip_ms=round(float(flip_ms), 3), **fields)
+
+
 def _percentile(sorted_vals, q: float) -> float:
     """Nearest-rank percentile over an ascending list (stdlib-only —
     telemetry must not import numpy)."""
@@ -850,6 +874,8 @@ def _serving_rollup() -> dict:
         "queue_depth": sv["queue_depth"],
         "active_slots": sv["active_slots"],
         "precision": sv.get("precision", "fp32"),
+        "weight_generation": sv.get("weight_generation", 0),
+        "weight_swaps": sv.get("weight_swaps", 0),
     }
 
 
@@ -1242,7 +1268,8 @@ def render_prometheus(mode: str = "live") -> str:
     gauge("mx_checkpoint_loads_total", ck["loads"], kind="counter")
     gauge("mx_checkpoint_fallbacks_total", ck["fallbacks"], kind="counter")
     sv = s["serving"]
-    if sv["requests"] or sv["queue_depth"] or sv["active_slots"]:
+    if sv["requests"] or sv["queue_depth"] or sv["active_slots"] \
+            or sv.get("weight_swaps"):
         gauge("mx_serve_requests_total", sv["requests"], kind="counter")
         gauge("mx_serve_tokens_total", sv["tokens"], kind="counter")
         gauge("mx_serve_queue_wait_ms_total", sv["queue_wait_ms"],
@@ -1259,6 +1286,12 @@ def render_prometheus(mode: str = "live") -> str:
                 f'stage="{stage}"}} {sv["slo_violations"][stage]}')
         gauge("mx_serve_queue_depth", sv["queue_depth"])
         gauge("mx_serve_active_slots", sv["active_slots"])
+        # hot-swap generation gauge + applied-swap counter: which weight
+        # set is serving, and how many flips it took to get there
+        gauge("mx_serve_weight_generation",
+              sv.get("weight_generation", 0))
+        gauge("mx_serve_weight_swaps_total", sv.get("weight_swaps", 0),
+              kind="counter")
         # info-style precision label (a NEW gauge, not a new label on
         # the existing series — label-set changes break scrapers)
         lines.append("# TYPE mx_serve_precision_info gauge")

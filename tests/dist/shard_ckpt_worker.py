@@ -1,0 +1,171 @@
+"""Shard-granular checkpoint chaos worker (ISSUE 16 acceptance): a
+2-process gang whose tp=4 mesh spans BOTH processes, so every param is
+cross-process-sharded — the state a gathered snapshot could only
+capture with a collective allgather (and the case PR 11's save_now had
+to refuse).  Here every rank persists exactly its own shards with ZERO
+collectives, and the telemetry checkpoint_save events record per-rank
+payload bytes as proof.
+
+Phase 0 (MX_SHARD_PHASE=0): uninterrupted 15-step run; rank 0 writes
+the final gathered state as the bitwise baseline.
+
+Phase 1 (MX_SHARD_PHASE=1): the supervised chaos run, launched under
+``tools/launch.py --max-restarts 1`` with
+``MX_FAULT_SPEC=crash:step=12:rank=1:if-restart=0``:
+
+  * sharded scheduled saves every 5 steps into ONE shared dir (rank 0
+    leads/publishes, rank 1 commits only its shard marker);
+  * at step 8 both ranks take an explicit off-cycle ``save_now`` — the
+    rank-local preemption snapshot on cross-process-sharded state that
+    used to be impossible — and step-8 must publish COMPLETE;
+  * the chaos harness kills rank 1 at step 12; the survivor's SIGTERM
+    handler best-effort-snapshots (its lone marker can only produce an
+    incomplete step that validation rejects);
+  * the restarted gang agrees on scheduled step 10
+    (latest_valid_step(multiple_of=5) + agree_resume_step), restores
+    the sharded checkpoint onto the fresh mesh, finishes training, and
+    the final weights must match the phase-0 baseline BITWISE.
+
+Run via tools/launch.py local mode (the test drives both phases).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# each process must see 2 virtual CPU devices BEFORE jax initializes;
+# the launcher's MX_FORCE_CPU pins the platform at rendezvous time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: E402  (rendezvous runs at import)
+from mxnet_tpu import checkpoint, fault, gluon, nd, telemetry
+from mxnet_tpu.parallel import DataParallelStep, make_mesh
+from mxnet_tpu.parallel.sharding import ShardingRules
+
+TOTAL_STEPS = 15
+SAVE_EVERY = 5
+
+
+def build_step():
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    devs = jax.devices()
+    assert len(devs) == 4, devs
+    # tp spans the process boundary: 4-way tensor parallel over
+    # 2 procs x 2 devices — no rank can address a full param
+    mesh = make_mesh(tp=4, devices=devs)
+    assert mesh.shape["tp"] == 4, dict(mesh.shape)
+
+    mx.context.Context._default_ctx.value = mx.cpu()
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Normal(0.5))
+    rules = ShardingRules([(r".*weight$", ("tp", None)),
+                           (r".*bias$", ("tp",))])
+    return DataParallelStep(net, gluon.loss.L2Loss(), mesh=mesh,
+                            optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-2},
+                            rules=rules)
+
+
+def batch(step_i):
+    rng = np.random.RandomState(1000 + step_i)
+    return (nd.array(rng.randn(8, 6).astype(np.float32)),
+            nd.array(rng.randn(8, 4).astype(np.float32)))
+
+
+def main():
+    phase = int(os.environ["MX_SHARD_PHASE"])
+    base = os.environ["MX_SHARD_DIR"]
+    telemetry.enable()  # MX_TELEMETRY_DIR: the per-rank save audit trail
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    step = build_step()
+
+    if phase == 0:
+        for step_i in range(TOTAL_STEPS):
+            X, Y = batch(step_i)
+            step.step(X, Y)
+        step.drain()
+        # the whole point of the sharded format: this state CANNOT be
+        # snapshotted rank-locally in gathered form (params place at
+        # the first step, so the probe runs after training)
+        assert step.snapshot_requires_collective(), \
+            "tp must span processes"
+        sd = step.state_dict()  # collective allgather: every rank calls
+        if rank == 0:
+            np.savez(os.path.join(base, "baseline.npz"), **sd["params"])
+        kv.barrier()
+        print(f"worker {rank}/{n}: shard baseline OK", flush=True)
+        return
+
+    # ------------------------------------------------------------------
+    # phase 1: supervised chaos (crash rank 1 @ step 12, restart once)
+    # ------------------------------------------------------------------
+    ckdir = os.path.join(base, "ckpt")  # ONE shared dir, all ranks
+    os.makedirs(ckdir, exist_ok=True)
+    restart = int(os.environ.get("MX_RESTART_COUNT", "0"))
+    local = checkpoint.latest_valid_step(ckdir, multiple_of=SAVE_EVERY)
+    start = checkpoint.agree_resume_step(local, kv)
+    if start:
+        restored = checkpoint.restore(ckdir, step, step=start)
+        assert restored == start, (restored, start)
+    if restart == 1:
+        # step-12 (lone survivor's SIGTERM snapshot) and any step-8
+        # off-cycle save must NOT win: the gang resumes at the newest
+        # complete SCHEDULED step
+        assert start == 10, f"expected agreed resume at 10, got {start}"
+    print(f"worker {rank}: incarnation {restart} resuming at step {start}",
+          flush=True)
+    ck = checkpoint.AsyncCheckpointer(ckdir, save_every=SAVE_EVERY, keep=3,
+                                      initial_step=start, sharded=True,
+                                      writer=(rank == 0))
+    fault.install_preemption_handler(ck, step)
+
+    for step_i in range(start, TOTAL_STEPS):
+        X, Y = batch(step_i)
+        step.step(X, Y)
+        if step_i == start:
+            step.drain()
+            assert step.snapshot_requires_collective(), \
+                "tp must span processes"
+        ck.step(step)  # chaos crash:step=12 fires in here on rank 1
+        if restart == 0 and (step_i + 1) % SAVE_EVERY == 0:
+            # deterministic chaos: both ranks' async shard writes for
+            # this scheduled step must be committed before the injected
+            # crash at step 12 can strike — otherwise the test races on
+            # whether step-10 published complete
+            ck.wait()
+            kv.barrier()
+        if step_i + 1 == 8 and restart == 0:
+            # explicit preemption-style snapshot on EVERY rank, in
+            # lockstep: rank-local shard writes compose a complete
+            # off-cycle step-8 with zero collectives
+            step.drain()
+            assert ck.save_now(step) == 8
+            kv.barrier()
+            assert checkpoint.latest_valid_step(ckdir) == 8, \
+                "lockstep save_now must publish a COMPLETE step"
+    ck.close()
+
+    final = step.state_dict()
+    if rank == 0:
+        ref = np.load(os.path.join(base, "baseline.npz"))
+        for name in ref.files:
+            np.testing.assert_array_equal(
+                ref[name], final["params"][name],
+                err_msg=f"param {name} diverged from baseline")
+    kv.barrier()
+    telemetry.flush()
+    print(f"worker {rank}/{n}: sharded resume OK (bitwise baseline match)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

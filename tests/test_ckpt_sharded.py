@@ -1,0 +1,352 @@
+"""Shard-granular checkpoint format (ISSUE 16 tentpole;
+docs/FAULT_TOLERANCE.md §Shard-granular checkpoints).
+
+Covers: the on-disk format-2 contract (per-rank shard files, atomic
+shard markers, manifest + layout in meta.json), bitwise save/restore
+parity on the same mesh, elastic resharding onto different meshes /
+device orders vs the gathered-format oracle, legacy format-1
+checkpoints loading through the same restore path, torn-shard
+step-level fallback, the ``torn-write:shard=R`` fault grammar, the
+rank-local ``save_now`` preemption path, the ``MX_CKPT_SHARDED`` knob,
+the checkpoint_save telemetry shape, and the ``tools/ckpt_report.py``
+offline audit CLI (exit 0/2/3).
+"""
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, fault, gluon, nd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import DataParallelStep, make_mesh
+from mxnet_tpu.parallel.sharding import ShardingRules
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every Dense weight/bias splits its leading axis over tp: on a tp=2
+# mesh each param has >= 2 shards, the multi-shard manifest surface
+_RULES = ShardingRules([
+    (r".*weight$", ("tp", None)),
+    (r".*bias$", ("tp",)),
+])
+
+
+def _make_step(seed=0, mesh=None):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Normal(0.5))
+    return DataParallelStep(net, gluon.loss.L2Loss(),
+                            mesh=mesh if mesh is not None
+                            else make_mesh(tp=2),
+                            optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-2},
+                            rules=_RULES)
+
+
+def _train(step, n, ckpts=()):
+    rng = np.random.RandomState(7)
+    X = rng.randn(8, 6).astype(np.float32)
+    Y = rng.randn(8, 4).astype(np.float32)
+    for _ in range(n):
+        step.step(nd.array(X), nd.array(Y))
+        for ck in ckpts:
+            ck.step(step)
+    step.drain()
+    for ck in ckpts:
+        ck.wait()
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """One trained tp=2 step checkpointed BOTH ways at the same state:
+    sharded format 2 and the gathered format-1 oracle, plus the bitwise
+    reference state_dict they both captured (step 4 = the final step)."""
+    step = _make_step(seed=0)
+    root = tmp_path_factory.mktemp("ckpt_sharded")
+    sharded_dir = str(root / "sharded")
+    gathered_dir = str(root / "gathered")
+    ck_s = checkpoint.AsyncCheckpointer(sharded_dir, save_every=2, keep=3,
+                                        sharded=True)
+    ck_g = checkpoint.AsyncCheckpointer(gathered_dir, save_every=4, keep=2)
+    _train(step, 4, ckpts=(ck_s, ck_g))
+    ck_s.close()
+    ck_g.close()
+    ref = step.state_dict()
+    return {"step": step, "sharded": sharded_dir, "gathered": gathered_dir,
+            "ref": ref}
+
+
+def _assert_bitwise(ref, other, opt=True):
+    for k in ref["params"]:
+        np.testing.assert_array_equal(ref["params"][k], other["params"][k],
+                                      err_msg=f"param {k}")
+    if opt:
+        for k in ref["opt_state"]:
+            np.testing.assert_array_equal(ref["opt_state"][k],
+                                          other["opt_state"][k],
+                                          err_msg=f"slot {k}")
+
+
+# ---------------------------------------------------------------------------
+# on-disk format
+# ---------------------------------------------------------------------------
+def test_sharded_format_manifest_and_digests(saved):
+    d = os.path.join(saved["sharded"], "step-4")
+    files = set(os.listdir(d))
+    assert {"meta.json", "shard-0.json", "params-shard-0.nd",
+            "optstate-shard-0.nd"} <= files
+    assert "params.nd" not in files  # no gathered payload in format 2
+    meta = json.load(open(os.path.join(d, "meta.json")))
+    assert meta["format"] == 2 and meta["step"] == 4
+    assert meta["world_size"] == 1
+    manifest = meta["manifest"]
+    # manifest is the global tensor map: every param carries shape,
+    # dtype and a shard list; the tp split makes them multi-shard
+    multi = {n: e for n, e in manifest["params"].items()
+             if len(e["shards"]) > 1}
+    assert multi, manifest["params"]
+    for name, ent in manifest["params"].items():
+        assert tuple(ent["shape"]) and ent["dtype"]
+        for sh in ent["shards"]:
+            assert sh["rank"] == 0  # single-process: rank 0 owns all
+            assert len(sh["slice"]) == len(ent["shape"])
+    # a tp-split weight's shards tile axis 0 disjointly
+    name, ent = sorted(multi.items())[0]
+    starts = sorted(tuple(s["slice"][0]) for s in ent["shards"])
+    assert starts[0][0] == 0 and starts[-1][1] == ent["shape"][0]
+    # adam slots ride the same format in optstate-shard-R.nd
+    assert manifest["opt_state"], meta
+    # the per-rank marker's digests must verify against the shard files
+    marker = json.load(open(os.path.join(d, "shard-0.json")))
+    assert marker["rank"] == 0 and marker["step"] == 4
+    for fname, want in marker["digests"].items():
+        got = hashlib.sha256(
+            open(os.path.join(d, fname), "rb").read()).hexdigest()
+        assert got == want, fname
+    # layout rides next to the manifest: the elastic-resume inputs
+    assert meta["layout"]["optimizer"] == "adam"
+    assert checkpoint.latest_valid_step(saved["sharded"]) == 4
+
+
+def test_sharded_roundtrip_bitwise_same_mesh(saved):
+    step2 = _make_step(seed=1)  # different init: restore must overwrite
+    assert checkpoint.restore(saved["sharded"], step2) == 4
+    _assert_bitwise(saved["ref"], step2.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# elastic reshard + mixed-version loads
+# ---------------------------------------------------------------------------
+def test_elastic_reshard_matches_gathered_oracle(saved):
+    """tp=2 shards restored onto a dp-only mesh must equal the SAME
+    state restored from the gathered-format oracle — the N->M resize
+    path never changes values, only placement."""
+    import jax
+
+    from_sharded = _make_step(seed=2, mesh=make_mesh())
+    assert checkpoint.restore(saved["sharded"], from_sharded) == 4
+    from_gathered = _make_step(seed=3, mesh=make_mesh())
+    assert checkpoint.restore(saved["gathered"], from_gathered) == 4
+    _assert_bitwise(saved["ref"], from_sharded.state_dict())
+    _assert_bitwise(from_gathered.state_dict(), from_sharded.state_dict())
+    # grow/shrink the dp extent (4-device vs 2-device submesh): each
+    # target materializes only its own shards, values stay bitwise
+    devs = jax.devices()
+    for sub in (devs[:4], devs[:2]):
+        tgt = _make_step(seed=4, mesh=make_mesh(devices=sub))
+        assert checkpoint.restore(saved["sharded"], tgt) == 4
+        _assert_bitwise(saved["ref"], tgt.state_dict())
+
+
+def test_reshard_same_size_different_device_order(saved):
+    """Same mesh SHAPE but a permuted device assignment (the restarted
+    gang that enumerated devices differently) still restores bitwise."""
+    import jax
+
+    tgt = _make_step(seed=5, mesh=make_mesh(tp=2,
+                                            devices=jax.devices()[::-1]))
+    assert checkpoint.restore(saved["sharded"], tgt) == 4
+    _assert_bitwise(saved["ref"], tgt.state_dict())
+
+
+def test_legacy_gathered_checkpoint_loads(saved):
+    """Format-1 checkpoints (no ``format`` key / no manifest) keep
+    loading through the same restore path — mixed-version fleets."""
+    meta = json.load(open(os.path.join(saved["gathered"], "step-4",
+                                       "meta.json")))
+    assert int(meta.get("format", 1)) == 1 and "manifest" not in meta
+    tgt = _make_step(seed=6)
+    assert checkpoint.restore(saved["gathered"], tgt) == 4
+    _assert_bitwise(saved["ref"], tgt.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# torn shards: fallback + fault grammar
+# ---------------------------------------------------------------------------
+def _corrupt(path):
+    with open(path, "r+b") as f:
+        f.truncate(max(os.path.getsize(path) // 2, 16))
+
+
+def test_corrupt_single_shard_falls_back_a_step(saved, tmp_path):
+    d = str(tmp_path / "c")
+    shutil.copytree(saved["sharded"], d)
+    _corrupt(os.path.join(d, "step-4", "params-shard-0.nd"))
+    # one torn shard invalidates the STEP, not the directory: validation
+    # rejects step 4 and the scheduled step 2 is the newest valid one
+    assert checkpoint.latest_valid_step(d) == 2
+    assert checkpoint.latest_valid_step(d, multiple_of=2) == 2
+    assert checkpoint.agree_resume_step(
+        checkpoint.latest_valid_step(d, multiple_of=2)) == 2
+    tgt = _make_step(seed=7)
+    assert checkpoint.restore(d, tgt) == 2
+    # pinning the torn step explicitly must refuse LOUDLY, not half-load
+    with pytest.raises(MXNetError):
+        checkpoint.load_checkpoint_state(d, step=4)
+    with pytest.raises(MXNetError):
+        checkpoint.restore(d, _make_step(seed=8), step=4)
+
+
+def test_missing_shard_marker_invalidates_step(saved, tmp_path):
+    """A rank that never committed its marker (mid-preemption death)
+    leaves an incomplete step that validation rejects."""
+    d = str(tmp_path / "m")
+    shutil.copytree(saved["sharded"], d)
+    os.unlink(os.path.join(d, "step-4", "shard-0.json"))
+    assert checkpoint.latest_valid_step(d) == 2
+
+
+def test_torn_write_shard_grammar_and_injection(tmp_path, monkeypatch):
+    (f,) = fault.parse_spec("torn-write:step=4:shard=0")
+    assert f.kind == "torn-write" and f.shard == 0 and f.step == 4
+    with pytest.raises(MXNetError, match="shard=R only applies"):
+        fault.parse_spec("crash:step=4:shard=0")
+    with pytest.raises(MXNetError, match="shard"):
+        fault.parse_spec("torn-write:step=4:shard=x")
+    # end-to-end: the injected tear hits exactly rank 0's param shard
+    # file of step 4, post-publish — restore falls back to step 2
+    monkeypatch.setenv("MX_FAULT_SPEC", "torn-write:step=4:shard=0")
+    d = str(tmp_path / "torn")
+    step = _make_step(seed=9)
+    ck = checkpoint.AsyncCheckpointer(d, save_every=2, keep=3, sharded=True)
+    _train(step, 4, ckpts=(ck,))
+    ck.close()
+    monkeypatch.delenv("MX_FAULT_SPEC")
+    assert os.path.exists(os.path.join(d, "step-4", "params-shard-0.nd"))
+    assert checkpoint.latest_valid_step(d) == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption save_now + writer narrowing + knobs
+# ---------------------------------------------------------------------------
+def test_save_now_sharded_off_cycle(tmp_path):
+    """The SIGTERM path: an off-schedule rank-local shard snapshot at
+    whatever step preemption caught us, restorable bitwise."""
+    d = str(tmp_path / "now")
+    step = _make_step(seed=10)
+    ck = checkpoint.AsyncCheckpointer(d, save_every=50, sharded=True)
+    _train(step, 3, ckpts=(ck,))
+    assert ck.save_now(step) == 3
+    ck.close()
+    meta = json.load(open(os.path.join(d, "step-3", "meta.json")))
+    assert meta["format"] == 2
+    assert checkpoint.latest_valid_step(d) == 3
+    tgt = _make_step(seed=11)
+    assert checkpoint.restore(d, tgt) == 3
+    _assert_bitwise(step.state_dict(), tgt.state_dict())
+
+
+def test_non_writer_rank_still_writes_its_shards(tmp_path):
+    """writer=False narrows a rank to per-shard writing instead of
+    sitting saves out entirely: it commits its shard files + marker into
+    the gang-shared staging dir; only the writer=True leader publishes
+    (so a lone peer leaves a staged-but-unpublished step)."""
+    d = str(tmp_path / "nw")
+    step = _make_step(seed=12)
+    ck = checkpoint.AsyncCheckpointer(d, save_every=2, writer=False,
+                                      sharded=True)
+    _train(step, 2, ckpts=(ck,))
+    ck.close()
+    staged = os.path.join(d, ".tmp-2-shard")
+    assert {"params-shard-0.nd", "optstate-shard-0.nd",
+            "shard-0.json"} <= set(os.listdir(staged))
+    assert not os.path.exists(os.path.join(d, "step-2"))  # no leader
+    assert checkpoint.latest_valid_step(d) == 0
+
+
+def test_mx_ckpt_sharded_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_CKPT_SHARDED", "1")
+    ck = checkpoint.AsyncCheckpointer(str(tmp_path / "a"))
+    assert ck.sharded
+    ck.close()
+    monkeypatch.setenv("MX_CKPT_SHARDED", "0")
+    ck = checkpoint.AsyncCheckpointer(str(tmp_path / "b"))
+    assert not ck.sharded
+    ck.close()
+    monkeypatch.delenv("MX_CKPT_SHARDED")
+    ck = checkpoint.AsyncCheckpointer(str(tmp_path / "c"))
+    assert not ck.sharded  # gathered stays the default
+    ck.close()
+
+
+def test_sharded_save_telemetry_event(tmp_path):
+    """Each rank's save books ONE checkpoint_save event tagged
+    sharded=true with its OWN payload bytes — the zero-collective
+    audit trail the dist chaos test reads per rank."""
+    telemetry.reset()
+    telemetry.enable(str(tmp_path / "tele"))
+    try:
+        d = str(tmp_path / "t")
+        step = _make_step(seed=13)
+        ck = checkpoint.AsyncCheckpointer(d, save_every=2, sharded=True)
+        _train(step, 2, ckpts=(ck,))
+        ck.close()
+        telemetry.flush()
+        events = [json.loads(line) for line in
+                  open(telemetry.event_path(str(tmp_path / "tele"), 0))]
+        saves = [e for e in events if e["kind"] == "checkpoint_save"
+                 and e.get("sharded")]
+        assert len(saves) == 1, events
+        assert saves[0]["rank"] == 0 and saves[0]["nbytes"] > 0
+        assert saves[0]["step"] == 2
+    finally:
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# offline audit CLI
+# ---------------------------------------------------------------------------
+def _report(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "ckpt_report.py"),
+         *args], capture_output=True, text=True, timeout=60)
+
+
+def test_ckpt_report_clean_corrupt_and_usage(saved, tmp_path):
+    res = _report(saved["sharded"])
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "sharded" in res.stdout and "all checkpoints verify" in res.stdout
+    res = _report(saved["sharded"], "--json")
+    assert res.returncode == 0
+    rep = json.loads(res.stdout)
+    assert rep["latest"] == 4 and not rep["anomalies"]
+    assert all(s["valid"] and s["format"] == 2 for s in rep["steps"])
+    assert rep["steps"][-1]["ranks"]["0"]["shards"] > 0
+    # corrupt one shard: exit 3 and a rank-attributed digest complaint
+    d = str(tmp_path / "bad")
+    shutil.copytree(saved["sharded"], d)
+    _corrupt(os.path.join(d, "step-4", "params-shard-0.nd"))
+    res = _report(d)
+    assert res.returncode == 3, res.stdout
+    assert "rank 0" in res.stdout and "INVALID" in res.stdout
+    res = _report(d, "--step", "2")  # the surviving step alone is clean
+    assert res.returncode == 0, res.stdout
+    assert _report(str(tmp_path / "nope")).returncode == 2

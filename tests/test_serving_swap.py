@@ -1,0 +1,256 @@
+"""Zero-downtime weight hot-swap (ISSUE 16 tentpole;
+docs/SERVING.md §Weight hot-swap).
+
+Covers: the mid-stream flip (a pending swap applies at a stream
+boundary while requests are in flight — zero dropped requests, zero
+fresh decode compiles, post-swap outputs bitwise equal a fresh engine
+booted on the new weights), the verify-before-publish rejection path
+(fingerprint mismatch keeps the old weights, loudly), swapping straight
+from a shard-granular format-2 checkpoint, the memwatch "staging"
+census draining after the flip, and the weight-generation telemetry
+(summary rollup, ``weight_swap`` events, ``mx_serve_weight_generation``
+prometheus gauge).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, memwatch, nd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.transformer import Transformer, label_smoothed_ce
+from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+PAD, BOS, EOS = 0, 1, 2
+
+
+@pytest.fixture
+def tele(tmp_path):
+    telemetry.reset()
+    memwatch.reset()
+    telemetry.enable(str(tmp_path))
+    yield telemetry
+    telemetry.reset()
+    memwatch.reset()
+
+
+def _warm(net):
+    # materialize deferred shapes: checkpoint/swap need concrete params
+    net(nd.array([[3, 4, 5, 0, 0]], dtype="int32"),
+        nd.array([[BOS, 3, 4, 5, 0, 0]], dtype="int32"))
+    return net
+
+
+def _tiny_model(seed=0):
+    mx.random.seed(seed)
+    net = Transformer(16, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=48, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    return _warm(net)
+
+
+def _engine(net, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_len", 16)
+    kw.setdefault("stream_every", 2)
+    return ServingEngine(TransformerAdapter(net, src_max_len=8), **kw)
+
+
+def _gathered_ckpt(net, d):
+    ck = checkpoint.AsyncCheckpointer(d, save_every=1, keep=2)
+    ck.step(net)
+    ck.close()
+    return d
+
+
+def _reqs(rng, n, max_new=8, tag=""):
+    return [Request(rng.randint(3, 16, 5), max_new_tokens=max_new,
+                    bos_id=BOS, eos_id=EOS, request_id=f"{tag}{i}")
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# the mid-stream flip
+# ---------------------------------------------------------------------------
+def test_hot_swap_mid_stream_zero_drop_zero_recompile(tele, tmp_path):
+    """ACCEPTANCE: a swap staged while the run loop is live applies at
+    the next stream boundary — wave A finishes across the flip with
+    nothing dropped, wave B (arriving after) decodes bitwise-identical
+    to a fresh engine booted on the new checkpoint, and the trace books
+    exactly ONE decode compile (the swap never recompiles)."""
+    net_a, net_b = _tiny_model(0), _tiny_model(7)
+    ckdir = _gathered_ckpt(net_b, str(tmp_path / "ck"))
+    eng = _engine(net_a)
+    eng._ensure_compiled()
+    rng = np.random.RandomState(3)
+    wave_a = _reqs(rng, 2, max_new=10, tag="a")
+    wave_b = _reqs(rng, 2, max_new=10, tag="b")
+    # stage the swap as a LIVE run loop would see it: with the engine
+    # marked running the flip must defer to a stream boundary, not
+    # apply synchronously here
+    eng._running = True
+    try:
+        assert eng.swap_weights(ckdir) == 1
+    finally:
+        eng._running = False
+    assert eng.weight_generation == 0 and eng._swap_pending is not None
+    # staging census: the transient 2x-weights window is attributed
+    assert memwatch.census()["host_bytes"]["staging"] > 0
+    flip_steps = []
+    orig_apply = eng._apply_pending_swap
+    eng._apply_pending_swap = (
+        lambda: (flip_steps.append(eng.step_count), orig_apply())[-1])
+    out = eng.serve(wave_a + wave_b, arrival_steps=[0, 0, 8, 8])
+    # the pending swap flipped at the FIRST stream boundary inside
+    # run() — wave A was mid-flight, wave B hadn't even arrived
+    assert eng.weight_generation == 1
+    assert flip_steps == [2], flip_steps
+    assert eng._swap_pending is None and not eng._staging
+    assert memwatch.census()["host_bytes"].get("staging", 0) == 0
+    # zero dropped: every request (in-flight and post-swap) completed
+    for r in wave_a + wave_b:
+        assert len(out[r.id]) == r.max_new_tokens, r.id
+        assert r.stream.finished
+    # post-swap arrivals must match a FRESH engine on the new weights
+    fresh = _engine(_tiny_model(7))
+    ref = fresh.serve([Request(r.tokens, max_new_tokens=10, bos_id=BOS,
+                               eos_id=EOS, request_id=r.id)
+                       for r in wave_b])
+    for r in wave_b:
+        np.testing.assert_array_equal(out[r.id], ref[r.id])
+    # and the swap visibly changed the model: wave B != what the OLD
+    # weights would have produced for the same prompts
+    old = _engine(_tiny_model(0)).serve(
+        [Request(r.tokens, max_new_tokens=10, bos_id=BOS, eos_id=EOS,
+                 request_id=r.id) for r in wave_b])
+    assert all(not np.array_equal(out[r.id], old[r.id]) for r in wave_b)
+    # zero fresh compiles: one decode + one prefill executable, total
+    telemetry.flush()
+    events = [json.loads(line)
+              for line in open(telemetry.event_path(str(tmp_path), 0))]
+    compiles = [e for e in events if e["kind"] == "compile"
+                and e.get("executor") == "ServingEngine"]
+    assert sorted(e["site"] for e in compiles) == \
+        ["serving_decode", "serving_prefill"], compiles
+    # the weight_swap event rode into the JSONL with its payload facts
+    swaps = [e for e in events if e["kind"] == "weight_swap"]
+    assert len(swaps) == 1 and swaps[0]["generation"] == 1
+    assert swaps[0]["staged_bytes"] > 0 and swaps[0]["step"] == 1
+    sv = telemetry.summary()["serving"]
+    assert sv["weight_generation"] == 1 and sv["weight_swaps"] == 1
+    prom = telemetry.render_prometheus()
+    assert 'mx_serve_weight_generation{rank="0"} 1' in prom
+    assert "mx_serve_weight_swaps_total" in prom
+
+
+def test_idle_swap_applies_immediately(tele, tmp_path):
+    """No run loop live: swap_weights flips synchronously and the next
+    serve() call decodes on the new weights — parity with a fresh
+    engine, end to end."""
+    net_a, net_b = _tiny_model(0), _tiny_model(7)
+    ckdir = _gathered_ckpt(net_b, str(tmp_path / "ck"))
+    eng = _engine(net_a)
+    src = np.array([3, 4, 5, 6, 7], np.int32)
+    before = eng.serve([Request(src, max_new_tokens=6, bos_id=BOS,
+                                eos_id=EOS, request_id="r0")])["r0"]
+    assert eng.swap_weights(ckdir) == 1
+    assert eng.weight_generation == 1 and not eng._staging
+    after = eng.serve([Request(src, max_new_tokens=6, bos_id=BOS,
+                               eos_id=EOS, request_id="r1")])["r1"]
+    ref = _engine(_tiny_model(7)).serve(
+        [Request(src, max_new_tokens=6, bos_id=BOS, eos_id=EOS,
+                 request_id="r2")])["r2"]
+    np.testing.assert_array_equal(after, ref)
+    assert not np.array_equal(before, after)
+
+
+def test_swap_from_sharded_checkpoint(tele, tmp_path):
+    """Tentpole synergy: the engine hot-swaps straight out of a
+    shard-granular format-2 checkpoint (lazy shard composition feeds the
+    staging buffer; no gathered params.nd anywhere on disk)."""
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    net_a, net_b = _tiny_model(0), _tiny_model(7)
+    step = DataParallelStep(
+        net_b, lambda lo, la: label_smoothed_ce(lo, la, smoothing=0.0),
+        mesh=local_mesh(devices=[mx.current_context().jax_device]),
+        optimizer="adam", optimizer_params={"learning_rate": 5e-3})
+    rng = np.random.RandomState(2)
+    src = np.zeros((4, 6), np.int32)
+    src[:, :5] = rng.randint(3, 16, (4, 5))
+    tgt_in = np.zeros((4, 7), np.int32)
+    tgt_in[:, 0] = BOS
+    step.step((nd.array(src, dtype="int32"),
+               nd.array(tgt_in, dtype="int32")),
+              nd.array(tgt_in.astype(np.float32)))
+    step.sync_to_block()  # net_b now holds the trained weights
+    ckdir = str(tmp_path / "shard_ck")
+    ck = checkpoint.AsyncCheckpointer(ckdir, save_every=1, sharded=True)
+    ck.step(step)
+    ck.close()
+    meta = json.load(open(os.path.join(ckdir, "step-1", "meta.json")))
+    assert meta["format"] == 2
+    assert not os.path.exists(os.path.join(ckdir, "step-1", "params.nd"))
+
+    eng = _engine(net_a)
+    assert eng.swap_weights(ckdir) == 1
+    assert eng.weight_generation == 1
+    q = np.array([3, 4, 5], np.int32)
+    got = eng.serve([Request(q, max_new_tokens=6, bos_id=BOS, eos_id=EOS,
+                             request_id="s0")])["s0"]
+    ref = _engine(net_b).serve(
+        [Request(q, max_new_tokens=6, bos_id=BOS, eos_id=EOS,
+                 request_id="s1")])["s1"]
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# rejection: verify-before-publish
+# ---------------------------------------------------------------------------
+def test_swap_rejected_on_fingerprint_mismatch(tele, tmp_path):
+    """A checkpoint whose param structure doesn't match the compiled
+    decode executable is rejected LOUDLY: old weights keep serving,
+    generation unchanged, staging empty, a rejected weight_swap event
+    books the reason."""
+    big = _tiny_model(7)
+    ckdir = _gathered_ckpt(big, str(tmp_path / "ck"))
+    small = Transformer(16, units=16, hidden_size=32, num_heads=4,
+                        num_layers=1, max_length=48, dropout=0.0)
+    small.initialize(mx.init.Xavier())
+    eng = _engine(_warm(small))
+    src = np.array([3, 4, 5], np.int32)
+    before = eng.serve([Request(src, max_new_tokens=5, bos_id=BOS,
+                                eos_id=EOS, request_id="p0")])["p0"]
+    with pytest.raises(MXNetError, match="fingerprint|missing parameter"):
+        eng.swap_weights(ckdir)
+    assert eng.weight_generation == 0 and not eng._staging
+    assert eng._swap_pending is None
+    # still serving, on the ORIGINAL weights
+    after = eng.serve([Request(src, max_new_tokens=5, bos_id=BOS,
+                               eos_id=EOS, request_id="p1")])["p1"]
+    np.testing.assert_array_equal(before, after)
+    telemetry.flush()
+    events = [json.loads(line)
+              for line in open(telemetry.event_path(str(tmp_path), 0))]
+    rej = [e for e in events if e["kind"] == "weight_swap"
+           and e.get("rejected")]
+    assert len(rej) == 1 and rej[0]["generation"] == 0
+    assert telemetry.summary()["serving"]["weight_generation"] == 0
+
+
+def test_swap_rejects_missing_or_torn_checkpoint(tmp_path):
+    eng = _engine(_tiny_model(0))
+    os.makedirs(str(tmp_path / "empty"), exist_ok=True)
+    with pytest.raises(MXNetError, match="no valid checkpoint"):
+        eng.swap_weights(str(tmp_path / "empty"))
+    # a torn gathered checkpoint (bad digest) is invisible to the swap
+    ckdir = _gathered_ckpt(_tiny_model(7), str(tmp_path / "ck"))
+    pnd = os.path.join(ckdir, "step-1", "params.nd")
+    with open(pnd, "r+b") as f:
+        f.truncate(os.path.getsize(pnd) // 2)
+    with pytest.raises(MXNetError, match="no valid checkpoint"):
+        eng.swap_weights(ckdir)
+    assert eng.weight_generation == 0

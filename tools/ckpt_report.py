@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Offline checkpoint-directory audit (docs/FAULT_TOLERANCE.md
+§Shard-granular checkpoints).
+
+``mxnet_tpu/checkpoint.py`` writes ``step-N/`` dirs in two formats: the
+gathered format (``params.nd`` + digests in ``meta.json``) and the
+shard-granular format 2 (``params-shard-R.nd`` / ``optstate-shard-R.nd``
+per rank, per-rank ``shard-R.json`` digest markers, and a shard manifest
+in ``meta.json`` next to ``layout``).  This CLI answers the after-the-run
+questions without loading a single tensor:
+
+  * **per-step verdict** — meta parse, SHA-256 digest verification of
+    every recorded payload (meta-level digests for format 1,
+    per-rank marker digests for format 2), and whether restore would
+    accept the step;
+  * **per-rank shard table** (format 2) — each rank's shard-file sizes
+    and shard counts, the zero-collective scaling signal on disk: a
+    rank's bytes track the shards it owns, not the global param count;
+  * **missing / orphan shard detection** — manifest shards whose rank
+    never committed a marker or whose ``name#j`` key is absent from the
+    rank's file, and shard files / keys on disk the manifest never
+    mentions (a stale rank from a previous world size);
+  * **layout vs manifest consistency** — every layout spec key must
+    appear in the manifest (and vice versa), and no manifest shard may
+    cite a rank >= the recorded world size.
+
+Exit code: 0 clean, 2 usage/IO error (missing directory, no step dirs),
+3 when any step is invalid or inconsistent — CI and the launch.py
+supervisor can key off it, mirroring ``trace_report.py`` /
+``mem_report.py``.  ``--json`` emits the full report object; ``--step N``
+audits one step only.
+
+Importable WITHOUT jax/numpy/mxnet_tpu (stdlib only): the native ``.nd``
+header (magic ``MXTPND01`` | u64 header_len | JSON header | raw
+payloads) and the checkpoint dir protocol are parsed directly — keep in
+sync with ``mxnet_tpu/ndarray/utils.py`` and ``mxnet_tpu/checkpoint.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import struct
+import sys
+from typing import Dict, List, Optional
+
+__all__ = ["audit_step", "build_report", "format_text", "main"]
+
+_ND_MAGIC = b"MXTPND01"
+_SHARD_PREFIX = {"params": "params-shard", "opt_state": "optstate-shard"}
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def read_nd_header(path: str) -> dict:
+    """Parse a native .nd file's JSON header (names/dtypes/shapes/nbytes)
+    without decoding any payload; raises ValueError on a foreign or
+    truncated header."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_ND_MAGIC))
+        if magic != _ND_MAGIC:
+            raise ValueError(f"{path}: not a native .nd file")
+        raw = f.read(8)
+        if len(raw) != 8:
+            raise ValueError(f"{path}: truncated header length")
+        (hlen,) = struct.unpack("<Q", raw)
+        blob = f.read(hlen)
+        if len(blob) != hlen:
+            raise ValueError(f"{path}: truncated header")
+        return json.loads(blob.decode())
+
+
+def _nd_keys(path: str) -> Dict[str, dict]:
+    """{name: entry} of a native .nd file, header-only."""
+    return {e["name"]: e for e in read_nd_header(path).get("entries", [])}
+
+
+def _manifest_shards(manifest: dict):
+    """Yield (section, name, shard_dict) over a format-2 manifest."""
+    for section in ("params", "opt_state"):
+        for name, ent in (manifest.get(section) or {}).items():
+            for sh in ent.get("shards", []):
+                yield section, name, sh
+
+
+def audit_step(d: str) -> dict:
+    """Audit one ``step-N`` dir; returns {step, format, valid, issues,
+    ranks: {rank: {files: {fname: bytes}, shards}}, total_bytes}."""
+    issues: List[str] = []
+    out = {"dir": d, "format": 0, "valid": False, "issues": issues,
+           "ranks": {}, "total_bytes": 0}
+    try:
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        issues.append(f"meta.json unreadable: {e}")
+        return out
+    if not isinstance(meta, dict) or "step" not in meta:
+        issues.append("meta.json carries no step")
+        return out
+    out["step"] = meta["step"]
+    fmt = int(meta.get("format", 1))
+    out["format"] = fmt
+    try:
+        out["total_bytes"] = sum(
+            os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
+    except OSError:
+        pass
+    # meta-level digests (format 1: all payloads; format 2: trainer.states)
+    for fname, want in (meta.get("digests") or {}).items():
+        path = os.path.join(d, fname)
+        if not os.path.exists(path):
+            issues.append(f"digest-listed file missing: {fname}")
+            continue
+        if _sha256_file(path) != want:
+            issues.append(f"digest mismatch: {fname}")
+    if fmt < 2:
+        if meta.get("digests") is None and not os.path.exists(
+                os.path.join(d, "params.nd")):
+            issues.append("pre-digest checkpoint missing params.nd")
+        out["valid"] = not issues
+        return out
+    manifest = meta.get("manifest") or {}
+    layout = meta.get("layout") or {}
+    world = meta.get("world_size") or layout.get("world_size")
+    # ------------------------------------------------------------------
+    # layout vs manifest consistency
+    # ------------------------------------------------------------------
+    specs = set((layout.get("specs") or {}))
+    mparams = set(manifest.get("params") or {})
+    for name in sorted(specs - mparams):
+        issues.append(f"layout spec {name!r} missing from manifest")
+    for name in sorted(mparams - specs):
+        if specs:  # a layout without specs can't be cross-checked
+            issues.append(f"manifest param {name!r} absent from layout "
+                          "specs")
+    ranks_needed: Dict[int, Dict[str, set]] = {}
+    for section, name, sh in _manifest_shards(manifest):
+        r = int(sh["rank"])
+        if world is not None and r >= int(world):
+            issues.append(
+                f"manifest shard {name}#{sh.get('j')} cites rank {r} "
+                f">= world_size {world}")
+        ranks_needed.setdefault(r, {"params": set(), "opt_state": set()})
+        ranks_needed[r][section].add(f"{name}#{sh.get('j', 0)}")
+    # ------------------------------------------------------------------
+    # per-rank shard files: markers, digests, key coverage
+    # ------------------------------------------------------------------
+    for r in sorted(ranks_needed):
+        row = {"files": {}, "shards": 0}
+        out["ranks"][r] = row
+        mpath = os.path.join(d, f"shard-{r}.json")
+        try:
+            with open(mpath) as f:
+                marker = json.load(f)
+        except (OSError, ValueError) as e:
+            issues.append(f"rank {r}: shard-{r}.json unreadable ({e})")
+            continue
+        digests = marker.get("digests") or {}
+        for fname, want in digests.items():
+            path = os.path.join(d, fname)
+            if not os.path.exists(path):
+                issues.append(f"rank {r}: committed file missing: {fname}")
+                continue
+            row["files"][fname] = os.path.getsize(path)
+            if _sha256_file(path) != want:
+                issues.append(f"rank {r}: digest mismatch: {fname}")
+        for section, keys in ranks_needed[r].items():
+            if not keys:
+                continue
+            fname = f"{_SHARD_PREFIX[section]}-{r}.nd"
+            path = os.path.join(d, fname)
+            if fname not in digests:
+                issues.append(f"rank {r}: {fname} owed by manifest but "
+                              "not committed")
+                continue
+            if not os.path.exists(path):
+                continue  # already flagged above
+            try:
+                entries = _nd_keys(path)
+            except (ValueError, OSError) as e:
+                issues.append(f"rank {r}: {fname} header unreadable ({e})")
+                continue
+            row["shards"] += len(entries)
+            missing = sorted(keys - set(entries))
+            for k in missing[:4]:
+                issues.append(f"rank {r}: {fname} missing shard key {k}")
+            if len(missing) > 4:
+                issues.append(f"rank {r}: {fname} missing "
+                              f"{len(missing) - 4} more shard keys")
+            for k in sorted(set(entries) - keys):
+                issues.append(f"rank {r}: {fname} orphan shard key {k} "
+                              "(not in manifest)")
+    # ------------------------------------------------------------------
+    # orphan shard files: on disk but owed by no manifest shard
+    # ------------------------------------------------------------------
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    for fname in sorted(names):
+        for section, prefix in _SHARD_PREFIX.items():
+            if not (fname.startswith(f"{prefix}-")
+                    and fname.endswith(".nd")):
+                continue
+            try:
+                r = int(fname[len(prefix) + 1:-3])
+            except ValueError:
+                continue
+            if r not in ranks_needed or not ranks_needed[r][section]:
+                issues.append(f"orphan shard file: {fname} (manifest "
+                              f"owes rank {r} nothing in {section})")
+    out["valid"] = not issues
+    return out
+
+
+def build_report(directory: str, step: Optional[int] = None) -> dict:
+    steps = []
+    try:
+        names = os.listdir(directory)
+    except OSError as e:
+        raise OSError(f"cannot read {directory}: {e}") from e
+    for dname in sorted(names):
+        if not dname.startswith("step-"):
+            continue
+        try:
+            s = int(dname.split("-", 1)[1])
+        except ValueError:
+            continue
+        if step is not None and s != step:
+            continue
+        steps.append(audit_step(os.path.join(directory, dname)))
+    steps.sort(key=lambda r: r.get("step", -1))
+    latest = None
+    try:
+        with open(os.path.join(directory, "latest")) as f:
+            latest = int(f.read().strip())
+    except (OSError, ValueError):
+        pass
+    anomalies = [i for r in steps for i in r["issues"]]
+    return {"directory": directory, "latest": latest, "steps": steps,
+            "anomalies": anomalies}
+
+
+def format_text(rep: dict) -> str:
+    lines = [f"checkpoint dir: {rep['directory']}",
+             f"latest pointer: {rep['latest']}"]
+    for r in rep["steps"]:
+        fmt = {0: "?", 1: "gathered", 2: "sharded"}.get(r["format"],
+                                                        str(r["format"]))
+        verdict = "ok" if r["valid"] else "INVALID"
+        lines.append(f"  step {r.get('step', '?')}: {fmt} "
+                     f"{r['total_bytes']} B -> {verdict}")
+        for rank in sorted(r["ranks"]):
+            row = r["ranks"][rank]
+            files = ", ".join(f"{f}={n}B"
+                              for f, n in sorted(row["files"].items()))
+            lines.append(f"    rank {rank}: {row['shards']} shards "
+                         f"({files})")
+        for issue in r["issues"]:
+            lines.append(f"    ! {issue}")
+    if rep["anomalies"]:
+        lines.append(f"{len(rep['anomalies'])} issue(s) found")
+    else:
+        lines.append("all checkpoints verify")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Offline shard-manifest audit of a checkpoint "
+                    "directory (exit 0 clean / 2 usage-IO / 3 anomalies)")
+    ap.add_argument("directory", help="AsyncCheckpointer directory")
+    ap.add_argument("--step", type=int, default=None, metavar="N",
+                    help="audit only step N")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report object as JSON")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.directory):
+        print(f"ckpt_report: no such directory: {args.directory}",
+              file=sys.stderr)
+        return 2
+    try:
+        rep = build_report(args.directory, step=args.step)
+    except OSError as e:
+        print(f"ckpt_report: {e}", file=sys.stderr)
+        return 2
+    if not rep["steps"]:
+        print(f"ckpt_report: no step-* dirs in {args.directory}"
+              + (f" matching step {args.step}" if args.step is not None
+                 else ""), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(format_text(rep))
+    return 3 if rep["anomalies"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -690,6 +690,12 @@ class _HeartbeatMonitor:
                       f"last {len(tail)} events):", file=sys.stderr)
                 for line in tail:
                     print(f"  {line}", file=sys.stderr)
+                if any('"checkpoint_fallback"' in line for line in tail):
+                    # a restore skipped a torn/corrupt step — point at
+                    # the offline shard/digest audit for the WHY
+                    print("launch.py: checkpoint fallback detected — "
+                          "run tools/ckpt_report.py <ckpt-dir> to audit "
+                          "shard files and digests", file=sys.stderr)
             # a rank that died on RESOURCE_EXHAUSTED left a memory
             # post-mortem — echo WHY next to the flight tail's WHERE
             oom = _oom_report(self.dir, rank)
