@@ -350,6 +350,50 @@ def main():
             result["serving_throughput"] = srv
             print(json.dumps(result), flush=True)
 
+    # router_throughput: mixed traffic through the multi-replica HTTP
+    # front door vs ONE engine serving the same trace at equal outputs
+    # (docs/SERVING.md §Front door).  p99 TTFT is the headline — the
+    # router splits queue wait across replicas.
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_ROUTER", "1") != "0"
+            and "error" not in result):
+        rt = _run_child("cpu", float(os.environ.get(
+            "BENCH_ROUTER_TIMEOUT", 420)), history,
+            extra_env={"BENCH_MODEL": "router_throughput"})
+        if rt is not None:
+            rt.pop("probe_history", None)
+            result["router_throughput"] = rt
+            print(json.dumps(result), flush=True)
+
+    # prefix_cache: N requests sharing a forced decoder prefix, COW
+    # page-fork cache on vs off, outputs asserted bitwise equal
+    # (docs/SERVING.md §Prefix cache).
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_PREFIX", "1") != "0"
+            and "error" not in result):
+        pfx = _run_child("cpu", float(os.environ.get(
+            "BENCH_PREFIX_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "prefix_cache"})
+        if pfx is not None:
+            pfx.pop("probe_history", None)
+            result["prefix_cache"] = pfx
+            print(json.dumps(result), flush=True)
+
+    # spec_decode: n-gram prompt-lookup draft + one ragged verify
+    # dispatch per boundary vs the plain engine, greedy output bitwise
+    # identical; acceptance rate in the record (docs/SERVING.md
+    # §Speculative decoding).
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_SPEC", "1") != "0"
+            and "error" not in result):
+        sd = _run_child("cpu", float(os.environ.get(
+            "BENCH_SPEC_TIMEOUT", 300)), history,
+            extra_env={"BENCH_MODEL": "spec_decode"})
+        if sd is not None:
+            sd.pop("probe_history", None)
+            result["spec_decode"] = sd
+            print(json.dumps(result), flush=True)
+
     # plan_choice: the analytic auto-sharding planner's pick vs the worst
     # legal plan of the same mesh, measured steps/sec on a 2-device toy
     # net (docs/PERFORMANCE.md §Plan & planner).  Sanity floor: the
@@ -978,6 +1022,234 @@ def bench_serving_throughput(platform):
         "slots": slots, "requests": n_req,
         "decode_lengths": [int(x) for x in lens],
         "trials": trials,
+    }))
+
+
+def bench_router_throughput(platform):
+    """Secondary metric: the serving front door's mixed-traffic win —
+    tokens/sec AND p99 TTFT through a multi-replica Router (HTTP, session
+    affinity, least-outstanding dispatch) vs ONE engine serving the same
+    request trace, at EQUAL OUTPUTS (greedy decode: both runs emit
+    token-for-token identical streams, asserted in the record).  The
+    router splits queue wait across replicas, so the p99 TTFT drop is
+    the headline; the tokens/sec ratio rides along (bounded by how much
+    the host overlaps two engines' compiled steps — docs/SERVING.md
+    §Front door)."""
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import (ReplicaServer, Request, Router,
+                                   ServingEngine, TransformerAdapter)
+
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS", 24))
+    n_rep = int(os.environ.get("BENCH_ROUTER_REPLICAS", 2))
+    slots = int(os.environ.get("BENCH_ROUTER_SLOTS", 4))
+    clients = int(os.environ.get("BENCH_ROUTER_CLIENTS", 8))
+
+    mx.random.seed(0)
+    net = Transformer(64, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=64, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(3, 64, 8).tolist() for _ in range(n_req)]
+    lens = (7 + (np.arange(n_req) * 11) % 21).astype(int)
+
+    def mk_engine():
+        eng = ServingEngine(TransformerAdapter(net, src_max_len=8),
+                            slots=slots, page_size=8, max_len=40,
+                            stream_every=4, ctx=ctx)
+        eng.serve([Request(prompts[0], 4, bos_id=2, eos_id=1)])  # warm
+        return eng
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300.0) as r:
+            return json.load(r)
+
+    def drive(port):
+        bodies = [{"prompt": prompts[i], "max_new_tokens": int(lens[i]),
+                   "bos_id": 2, "eos_id": 1, "timeout_s": 300.0}
+                  for i in range(n_req)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=clients) as ex:
+            outs = list(ex.map(lambda b: post(port, b), bodies))
+        wall = time.perf_counter() - t0
+        toks = sum(len(o["tokens"]) for o in outs)
+        ttfts = sorted(o["ttft_ms"] for o in outs)
+        p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+        return outs, toks / wall, p99
+
+    # baseline: the SAME trace through one engine behind one replica
+    base = ReplicaServer(mk_engine(), bos_id=2, eos_id=1, rank=0,
+                         port=0, directory=tempfile.mkdtemp()).start()
+    outs_base, tps_base, p99_base = drive(base.port)
+    base.stop()
+
+    tmp = tempfile.mkdtemp()
+    reps = [ReplicaServer(mk_engine(), bos_id=2, eos_id=1, rank=i,
+                          port=0, directory=tmp).start()
+            for i in range(n_rep)]
+    router = Router(tmp, port=0, health_sec=60.0).start()
+    outs_r, tps_router, p99_router = drive(router.port)
+    routed_to = sorted({o["routed_to"] for o in outs_r})
+    router.stop()
+    for r in reps:
+        r.stop()
+
+    equal = all(a["tokens"] == b["tokens"]
+                for a, b in zip(outs_base, outs_r))
+    print(json.dumps({
+        "metric": "router_throughput",
+        "value": round(tps_router / tps_base, 3) if tps_base else 0.0,
+        "unit": "x_router_vs_single_engine",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "router_tokens_per_sec": round(tps_router, 2),
+        "single_tokens_per_sec": round(tps_base, 2),
+        "router_p99_ttft_ms": round(p99_router, 2),
+        "single_p99_ttft_ms": round(p99_base, 2),
+        "p99_ttft_ratio": round(p99_router / p99_base, 3)
+        if p99_base else 0.0,
+        "equal_outputs": bool(equal),
+        "replicas_used": routed_to,
+        "replicas": n_rep, "slots_each": slots,
+        "requests": n_req, "clients": clients,
+    }))
+
+
+def bench_prefix_cache(platform):
+    """Secondary metric: the copy-on-write prefix cache — wall clock and
+    mean TTFT for N requests sharing one forced decoder prefix, cache ON
+    (first request teacher-forces/ingests once, the rest FORK its pages)
+    vs OFF (every request re-ingests).  Outputs are asserted bitwise
+    equal between the runs — the cache trades nothing for the win
+    (docs/SERVING.md §Prefix cache)."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+    n_req = int(os.environ.get("BENCH_PREFIX_REQUESTS", 12))
+    plen = int(os.environ.get("BENCH_PREFIX_TOKENS", 24))
+    trials = int(os.environ.get("BENCH_PREFIX_TRIALS", 3))
+
+    mx.random.seed(0)
+    net = Transformer(64, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=64, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 64, 8).astype(np.int32)
+    prefix = rng.randint(3, 64, plen).astype(np.int32)
+
+    def run(cache_on):
+        eng = ServingEngine(TransformerAdapter(net, src_max_len=8),
+                            slots=4, page_size=8, max_len=plen + 12,
+                            stream_every=4, ctx=ctx,
+                            prefix_cache=cache_on)
+        # warm every executable (prefill, decode, ingest) off the clock
+        eng.serve([Request(src, 2, bos_id=2, eos_id=1,
+                           prefix=prefix[:5])])
+        walls = []
+        streams = None
+        hit_rate = 0.0
+        for _ in range(trials):
+            reqs = [Request(src, 8, bos_id=2, eos_id=1, prefix=prefix)
+                    for _ in range(n_req)]
+            t0 = time.perf_counter()
+            eng.serve(reqs)
+            walls.append(time.perf_counter() - t0)
+            streams = [list(r.stream) for r in reqs]
+        if eng._prefix is not None:
+            looked = eng._prefix.hits + eng._prefix.misses
+            hit_rate = eng._prefix.hits / looked if looked else 0.0
+        return min(walls), streams, hit_rate
+
+    wall_on, streams_on, hit_rate = run(True)
+    wall_off, streams_off, _ = run(False)
+    print(json.dumps({
+        "metric": "prefix_cache",
+        "value": round(wall_off / wall_on, 3) if wall_on else 0.0,
+        "unit": "x_cached_vs_cold",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "wall_cached_s": round(wall_on, 4),
+        "wall_cold_s": round(wall_off, 4),
+        "prefix_hit_rate": round(hit_rate, 4),
+        "bitwise_equal": bool(streams_on == streams_off),
+        "prefix_tokens": plen, "requests": n_req, "trials": trials,
+    }))
+
+
+def bench_spec_decode(platform):
+    """Secondary metric: speculative decoding — tokens/sec with the
+    n-gram prompt-lookup draft + ONE ("verify", K) ragged dispatch per
+    boundary vs the plain engine, on copy-heavy traffic (repetitive
+    continuations — the regime prompt-lookup drafting exists for).
+    Greedy output is asserted BITWISE identical; the acceptance rate
+    rides in the record (it bounds the achievable speedup: each accepted
+    token is a decode dispatch never issued — docs/SERVING.md
+    §Speculative decoding)."""
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import Request, ServingEngine, TransformerAdapter
+
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", 8))
+    spec_k = int(os.environ.get("BENCH_SPEC_K", 4))
+    max_new = int(os.environ.get("BENCH_SPEC_TOKENS", 24))
+    trials = int(os.environ.get("BENCH_SPEC_TRIALS", 3))
+
+    mx.random.seed(0)
+    net = Transformer(64, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=64, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(3, 64, 8).astype(np.int32)
+               for _ in range(n_req)]
+
+    def run(k):
+        eng = ServingEngine(TransformerAdapter(net, src_max_len=8),
+                            slots=4, page_size=8, max_len=40,
+                            stream_every=4, ctx=ctx, spec_k=k)
+        eng.serve([Request(prompts[0], 4, bos_id=2, eos_id=1)])  # warm
+        best = 0.0
+        streams = None
+        for _ in range(trials):
+            reqs = [Request(p, max_new, bos_id=2, eos_id=1)
+                    for p in prompts]
+            t0 = time.perf_counter()
+            eng.serve(reqs)
+            wall = time.perf_counter() - t0
+            best = max(best, sum(len(r.stream) for r in reqs) / wall)
+            streams = [list(r.stream) for r in reqs]
+        rate = (eng._spec_accepted / eng._spec_proposed
+                if eng._spec_proposed else 0.0)
+        return best, streams, rate
+
+    tps_plain, streams_plain, _ = run(0)
+    tps_spec, streams_spec, accept_rate = run(spec_k)
+    print(json.dumps({
+        "metric": "spec_decode",
+        "value": round(tps_spec / tps_plain, 3) if tps_plain else 0.0,
+        "unit": "x_speculative_vs_plain",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "speculative_tokens_per_sec": round(tps_spec, 2),
+        "plain_tokens_per_sec": round(tps_plain, 2),
+        "acceptance_rate": round(accept_rate, 4),
+        "greedy_bitwise": bool(streams_plain == streams_spec),
+        "spec_k": spec_k, "requests": n_req,
+        "max_new_tokens": max_new, "trials": trials,
     }))
 
 
@@ -1644,6 +1916,12 @@ def child_main(platform):
         bench_pipeline_overlap(platform)
     elif model == "serving_throughput":
         bench_serving_throughput(platform)
+    elif model == "router_throughput":
+        bench_router_throughput(platform)
+    elif model == "prefix_cache":
+        bench_prefix_cache(platform)
+    elif model == "spec_decode":
+        bench_spec_decode(platform)
     elif model == "plan_choice":
         bench_plan_choice(platform)
     elif model == "amp_step":

@@ -200,6 +200,64 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "(TPU), 1 forces it (interpret-mode tests), 0 pins the XLA "
         "gather path — the bitwise-parity path "
         "(serving/engine.py _serve_fused)"),
+    # serving front door (docs/SERVING.md §Front door / §Sampling /
+    # §Prefix cache / §Speculative decoding) — everything defaults OFF
+    # or to the greedy parity pin
+    "MX_SERVE_SAMPLING": (
+        "honored", "1 builds the engine with per-slot sampling state "
+        "(temperature/top-k/top-p/RNG as device decode state; default 0 "
+        "= greedy-only, trace and AOT fingerprint unchanged); a "
+        "temperature-0 request on a sampling engine is still BITWISE "
+        "greedy (serving/engine.py)"),
+    "MX_SERVE_SPEC_K": (
+        "honored", "speculative decoding draft depth (default 0 = off): "
+        "a host-side draft proposes up to K tokens and ONE compiled "
+        "(\"verify\", K) dispatch checks them all — greedy output stays "
+        "bitwise identical, sampling stays distribution-identical "
+        "(serving/engine.py, serving/speculative.py)"),
+    "MX_SERVE_PREFIX_CACHE": (
+        "honored", "1 enables the copy-on-write prefix cache (default "
+        "0): identical (source, forced-prefix) requests fork refcounted "
+        "KV pages + reuse prefill rows instead of recomputing; entries "
+        "are weight-generation-stamped and drop at a hot-swap flip "
+        "(serving/engine.py, serving/scheduler.py PrefixCache)"),
+    "MX_SERVE_PREFIX_ENTRIES": (
+        "honored", "prefix-cache LRU bound (default 64 entries); under "
+        "pool pressure entries also evict before any live request is "
+        "preempted (serving/engine.py _ensure_pages)"),
+    "MX_SERVE_PREFIX_CHUNK": (
+        "honored", "tokens per (\"ingest\", K) teacher-forcing dispatch "
+        "when a prefix misses the cache (default 8): one executable "
+        "reused for any prefix length (serving/engine.py "
+        "_ingest_prefix)"),
+    "MX_SERVE_PORT": (
+        "honored", "replica HTTP port: N binds N+rank (0/unset = "
+        "ephemeral); the bound port is advertised via "
+        "serve-port-<rank>.json under MX_TELEMETRY_DIR for router "
+        "discovery (serving/router.py ReplicaServer)"),
+    "MX_SERVE_ROUTER_PORT": (
+        "honored", "router bind port (0/unset = ephemeral) for the "
+        "multi-replica front door (serving/router.py Router)"),
+    "MX_SERVE_HOST": (
+        "honored", "bind host for replica servers and the router "
+        "(default 127.0.0.1; 0.0.0.0 exposes them cross-host) "
+        "(serving/router.py)"),
+    "MX_SERVE_HEALTH_SEC": (
+        "honored", "router health-poll cadence in seconds (default 2.0): "
+        "each tick re-discovers portfiles and probes every replica's "
+        "/healthz — dead replicas leave rotation, recovered/undrained "
+        "ones rejoin (serving/router.py Router)"),
+    "MX_SERVE_TEMPERATURE": (
+        "honored", "fleet-wide default sampling temperature applied at "
+        "the HTTP layer when a /generate body omits it (default 0 = "
+        "greedy; never consulted inside the engine) "
+        "(serving/router.py)"),
+    "MX_SERVE_TOP_K": (
+        "honored", "fleet-wide default top-k for /generate bodies that "
+        "omit it (default 0 = off) (serving/router.py)"),
+    "MX_SERVE_TOP_P": (
+        "honored", "fleet-wide default nucleus top-p for /generate "
+        "bodies that omit it (default 1.0 = off) (serving/router.py)"),
     # serving SLO counters (docs/SERVING.md §SLO telemetry; visible live
     # via the metrics endpoint and in the launch.py gang merge)
     "MX_SERVE_SLO_TTFT_MS": (

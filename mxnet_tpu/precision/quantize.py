@@ -304,6 +304,17 @@ class QuantizedAdapter:
             return self._inner.decode(F, tok, pos, table, keep, pages,
                                       rows, lengths, extra, pools)
 
+    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
+                      lengths, extra, pools):
+        with runtime.quant_scope(self._entries):
+            return self._inner.decode_logits(F, tok, pos, table, keep,
+                                             pages, rows, lengths, extra,
+                                             pools)
+
+    def advance_extra(self, F, extra, nxt, pos):
+        with runtime.quant_scope(self._entries):
+            return self._inner.advance_extra(F, extra, nxt, pos)
+
 
 def quantize_adapter(adapter, calib_data, calib_fn: Callable,
                      calib_mode: str = "naive",

@@ -46,7 +46,8 @@ from .. import telemetry
 from ..base import MXNetError, env_int
 from ..parallel.async_loss import AsyncResult, InflightRing
 from .paged_cache import PagedKVCache, PagedStepCache, page_coords, pages_for
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import (ContinuousBatchingScheduler, PrefixCache, Request,
+                        prefix_key)
 
 __all__ = ["ServingAdapter", "TransformerAdapter", "FullPrefixAdapter",
            "ServingEngine"]
@@ -65,6 +66,68 @@ def _serve_fused() -> bool:
     from ..ops import pallas
 
     return pallas.enabled() and pallas.use_compiled()
+
+
+# ---------------------------------------------------------------------------
+# traced sampling math (runs inside the ONE compiled decode/verify step)
+# ---------------------------------------------------------------------------
+def _filter_logits(logits, temp, topk, topp):
+    """Temperature/top-k/top-p filtered logits, per slot (jnp arrays,
+    trace-time).  logits (S, V); temp/topp (S,) f32; topk (S,) int32
+    (0 = off).  Returns (S, V) logits with masked-out entries at -inf —
+    gumbel-argmax over the result samples the truncated, temperature-
+    scaled distribution.  Rows with temp == 0 produce garbage here (the
+    1e-6 floor) and are discarded by the caller's ``where`` against the
+    greedy branch."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)
+    sdesc = jnp.take_along_axis(scaled, order, axis=-1)
+    kk = jnp.clip(jnp.where(topk > 0, topk, V), 1, V).astype(jnp.int32)
+    kth = jnp.take_along_axis(sdesc, (kk - 1)[:, None], axis=1)
+    filt = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus: drop tokens outside the smallest set whose cumulative
+    # (descending) probability reaches top_p; the head token always
+    # survives (cum - p_i == 0 < top_p).  top_p >= 1 is a hard off
+    # switch — float cumsum can touch 1.0 early and must not truncate.
+    fdesc = jnp.take_along_axis(filt, order, axis=-1)
+    pdesc = jax.nn.softmax(fdesc, axis=-1)
+    cum = jnp.cumsum(pdesc, axis=-1)
+    drop_desc = ((cum - pdesc) >= topp[:, None]) & (topp < 1.0)[:, None]
+    inv = jnp.argsort(order, axis=-1)
+    drop = jnp.take_along_axis(drop_desc, inv, axis=-1)
+    return jnp.where(drop, -jnp.inf, filt)
+
+
+def _split_keys(keys, n):
+    """Advance every slot's RNG key one step: (S, 2) uint32 keys ->
+    (new_keys (S, 2), subs (S, n, 2)).  Per-slot independent streams —
+    a request's randomness is a function of its own seed only, never of
+    slot assignment or batch composition."""
+    import jax
+
+    out = jax.vmap(lambda k: jax.random.split(k, n + 1))(keys)
+    return out[:, 0], out[:, 1:]
+
+
+def _gumbel_rows(subs, V):
+    """(S, 2) subkeys -> (S, V) float32 gumbel noise (one row per slot;
+    argmax(logits + gumbel) samples softmax(logits))."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.vmap(lambda k: jax.random.gumbel(k, (V,), jnp.float32))(subs)
+
+
+def _uniform_rows(subs):
+    """(S, 2) subkeys -> (S,) float32 U[0,1) — the accept coin flips."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.vmap(lambda k: jax.random.uniform(k, (), jnp.float32))(subs)
 
 
 # ---------------------------------------------------------------------------
@@ -132,11 +195,43 @@ class ServingAdapter:
         shapes before the engine traces (gluon Dense layers infer shapes
         on first call)."""
 
+    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
+                      lengths, extra, pools):
+        """Traced decode of ONE position for every slot, stopping at the
+        LOGITS: returns ((S, V) logits, new_extra dict, new_pools list)
+        with the KV write applied but NO token selected.  The engine's
+        sampling and speculative-verify bodies build on this — greedy
+        argmax, temperature sampling and draft acceptance are all
+        different selections over the same logits."""
+        raise NotImplementedError(
+            f"{type(self).__name__} implements neither decode_logits "
+            "nor decode — sampling and speculative serving need "
+            "decode_logits")
+
+    def advance_extra(self, F, extra, nxt, pos):
+        """Apply the CHOSEN token to adapter extra state (traced).  Most
+        adapters keep step-invariant extra state (e.g. the encoder
+        memory) and inherit this identity; an adapter whose extra state
+        records emitted tokens (FullPrefixAdapter's prompt buffer)
+        overrides it.  Speculative verify skips this hook — it requires
+        the identity behaviour (checked at engine construction)."""
+        return extra
+
     def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
                extra, pools):
-        """Traced decode of ONE position for every slot.  Returns
-        (next_tok (S,) int32, new_extra dict, new_pools list)."""
-        raise NotImplementedError
+        """Traced GREEDY decode of ONE position for every slot.  Returns
+        (next_tok (S,) int32, new_extra dict, new_pools list).  The
+        default composes :meth:`decode_logits` with the argmax-over-
+        log-softmax selection ``translate`` applies at beam_size=1 (the
+        bitwise greedy contract) and :meth:`advance_extra`."""
+        logits, new_extra, new_pools = self.decode_logits(
+            F, tok, pos, table, keep, pages, rows, lengths, extra, pools)
+        # argmax over log-softmax, the exact selection translate's beam
+        # update applies with beam_size=1 (token-for-token parity)
+        nxt = F.cast(F.argmax(logits.log_softmax(axis=-1), axis=-1),
+                     "int32")
+        new_extra = self.advance_extra(F, new_extra, nxt, pos)
+        return nxt, new_extra, new_pools
 
 
 class TransformerAdapter(ServingAdapter):
@@ -208,8 +303,8 @@ class TransformerAdapter(ServingAdapter):
         self.model(nd_array(src, ctx=ctx, dtype="int32"),
                    nd_array(tgt, ctx=ctx, dtype="int32"))
 
-    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
-               extra, pools):
+    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
+                      lengths, extra, pools):
         fused = self._resolved_fused()
         caches = [PagedStepCache(pools[2 * i], pools[2 * i + 1], table,
                                  pages, rows, keep,
@@ -217,14 +312,10 @@ class TransformerAdapter(ServingAdapter):
                   for i in range(self.num_layers)]
         logits = self.model._decode_step(F, tok, pos, extra["mem"],
                                          extra["src_keep"], caches)
-        # argmax over log-softmax, the exact selection translate's beam
-        # update applies with beam_size=1 (token-for-token parity)
-        nxt = F.cast(F.argmax(logits.log_softmax(axis=-1), axis=-1),
-                     "int32")
         new_pools = []
         for c in caches:
             new_pools.extend((c.k_pool, c.v_pool))
-        return nxt, extra, new_pools
+        return logits, extra, new_pools
 
 
 class FullPrefixAdapter(ServingAdapter):
@@ -268,24 +359,29 @@ class FullPrefixAdapter(ServingAdapter):
         state["buf"][slot] = row
         state["pos"][slot] = max(0, n - 1)
 
-    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
-               extra, pools):
+    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
+                      lengths, extra, pools):
         from ..ndarray import NDArray
         import jax.numpy as jnp
 
         buf = extra["buf"]
         logits = self._fn(F, buf)                      # (S, L, V)
-        S, L, V = logits.shape
         step = jnp.take_along_axis(
             logits._data, pos._data[:, None, None].astype(jnp.int32),
             axis=1)[:, 0]                              # (S, V)
-        lp = NDArray(step, ctx=buf.context).log_softmax(axis=-1)
-        nxt = F.cast(F.argmax(lp, axis=-1), "int32")
+        return NDArray(step, ctx=buf.context), extra, []
+
+    def advance_extra(self, F, extra, nxt, pos):
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        buf = extra["buf"]
+        S, L = buf.shape
         wpos = jnp.minimum(pos._data + 1, L - 1)
         new_buf = NDArray(
             buf._data.at[jnp.arange(S), wpos].set(nxt._data),
             ctx=buf.context)
-        return nxt, {"buf": new_buf}, []
+        return {"buf": new_buf}
 
 
 # ---------------------------------------------------------------------------
@@ -313,11 +409,45 @@ class ServingEngine:
                  pool_pages: Optional[int] = None, max_len: int = 64,
                  stream_every: Optional[int] = None,
                  queue_bound: Optional[int] = None, ctx=None,
-                 dtype: str = "float32"):
+                 dtype: str = "float32",
+                 sampling: Optional[bool] = None,
+                 spec_k: Optional[int] = None, draft=None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_entries: Optional[int] = None):
         from ..context import current_context
         from ..ndarray import zeros as nd_zeros
 
         self._adapter = adapter
+        # ---- front-door features, all default-OFF (parity-pinned):
+        # sampling adds per-slot temp/topk/topp/rng device state and a
+        # sampled decode body; spec_k > 0 switches the run loop to
+        # draft-propose + one ("verify", K) dispatch per boundary;
+        # prefix_cache turns on COW page sharing + prefill-row reuse.
+        self._sampling = (env_int("MX_SERVE_SAMPLING", 0) != 0
+                          if sampling is None else bool(sampling))
+        self._spec_k = max(0, spec_k if spec_k is not None
+                           else env_int("MX_SERVE_SPEC_K", 0))
+        if self._spec_k and not adapter.uses_pages:
+            raise MXNetError(
+                "speculative decoding (spec_k > 0) needs a paged-KV "
+                "adapter — the verify step teacher-forces K positions "
+                "through the paged cache")
+        self._draft = draft
+        if self._spec_k and self._draft is None:
+            from .speculative import NGramDraft
+
+            self._draft = NGramDraft()
+        prefix_on = (env_int("MX_SERVE_PREFIX_CACHE", 0) != 0
+                     if prefix_cache is None else bool(prefix_cache))
+        if prefix_on and not adapter.uses_pages:
+            raise MXNetError(
+                "the prefix cache shares paged KV pages — it needs a "
+                "paged-KV adapter (uses_pages)")
+        self._prefix = PrefixCache(
+            prefix_entries if prefix_entries is not None
+            else env_int("MX_SERVE_PREFIX_ENTRIES", 64)) \
+            if prefix_on else None
+        self._prefix_chunk = max(1, env_int("MX_SERVE_PREFIX_CHUNK", 8))
         # precision label of the compiled decode program (fp32, or int8
         # for a precision.QuantizedAdapter) — rides on the mx_serve_*
         # telemetry so dashboards can attribute latency/throughput to
@@ -349,9 +479,10 @@ class ServingEngine:
             # table wide enough that positions overrun by a full burst
             # (a request finishing mid-burst keeps decoding until the
             # stream boundary) land on zero -> trash page, never clamp
-            # into a live page
-            self._P = pages_for(self._max_len + self._stream_every,
-                                self._ps)
+            # into a live page; a speculative verify overruns by up to
+            # K+1 positions per boundary, whichever is larger
+            overrun = max(self._stream_every, self._spec_k + 1)
+            self._P = pages_for(self._max_len + overrun, self._ps)
         else:
             self._cache = None
             self._P = 1
@@ -369,6 +500,21 @@ class ServingEngine:
             pos=nd_zeros((self._S,), ctx=self._ctx, dtype="int32"),
             table=nd_zeros((self._S, self._P), ctx=self._ctx,
                            dtype="int32"))
+        # per-slot sampling state rides the compiled step ONLY when
+        # sampling is on: a greedy engine's state (and therefore its
+        # traced program and AOT fingerprint) is unchanged — the
+        # parity-pinned default
+        self._samp_names: List[str] = []
+        if self._sampling:
+            state["temp"] = nd_zeros((self._S,), ctx=self._ctx,
+                                     dtype="float32")
+            state["topk"] = nd_zeros((self._S,), ctx=self._ctx,
+                                     dtype="int32")
+            state["topp"] = nd_zeros((self._S,), ctx=self._ctx,
+                                     dtype="float32")
+            state["rng"] = nd_zeros((self._S, 2), ctx=self._ctx,
+                                    dtype="uint32")
+            self._samp_names = ["temp", "topk", "topp", "rng"]
         extra = adapter.extra_state(self._S, self._ctx, dtype)
         self._extra_names = list(extra)
         state.update(extra)
@@ -383,6 +529,11 @@ class ServingEngine:
 
         self._param_items = None
         self._run = None
+        self._vrun = None   # ("verify", K) speculative executable
+        self._irun = None   # ("ingest", K) prefix teacher-forcing
+        self._last_nprop = None
+        self._spec_proposed = 0  # lifetime draft tokens proposed
+        self._spec_accepted = 0  # lifetime draft tokens accepted
         self._prefill_run = None
         self._prefill_names: List[str] = []
         self._pending_compile: Dict = {}
@@ -410,11 +561,23 @@ class ServingEngine:
     # public API
     # ------------------------------------------------------------------
     def submit(self, request: Request) -> Request:
-        if request.max_new_tokens > self._max_len:
+        plen = int(request.prefix.size)
+        if plen + request.max_new_tokens > self._max_len:
             raise MXNetError(
-                f"request {request.id} max_new_tokens "
+                f"request {request.id} prefix {plen} + max_new_tokens "
                 f"{request.max_new_tokens} > engine max_len "
                 f"{self._max_len}")
+        if plen and not self._adapter.uses_pages:
+            raise MXNetError(
+                f"request {request.id} carries a decoder prefix but the "
+                "adapter has no paged KV cache to teacher-force it into "
+                "— fold the prefix into the prompt instead")
+        if request.temperature > 0 and not self._sampling:
+            raise MXNetError(
+                f"request {request.id} asks for temperature "
+                f"{request.temperature} but this engine was built "
+                "greedy-only — construct ServingEngine(sampling=True) "
+                "or set MX_SERVE_SAMPLING=1")
         self._adapter.validate(request)
         return self._sched.submit(request)
 
@@ -540,6 +703,20 @@ class ServingEngine:
         for name, p in self._param_items:
             p.set_data(staging[name])
         self._weight_generation += 1
+        # swap-aware prefix-cache invalidation: every cached prefix was
+        # stamped with the generation it was computed under; at the flip
+        # all older entries drop (and release their pages) BEFORE the
+        # next admission can fork them — a post-swap request can never
+        # decode against old-weight KV pages (tests/test_serving_swap).
+        if self._prefix is not None:
+            dropped = self._prefix.invalidate_stale(self._weight_generation)
+            for e in dropped:
+                self._release_prefix_entry(e)
+            if dropped:
+                telemetry.record(
+                    "serve_prefix_invalidate", executor="ServingEngine",
+                    dropped=len(dropped),
+                    generation=self._weight_generation)
         # drain the staging census: post-flip the transient 2x-weights
         # window is over and memwatch's "staging" category reads empty
         self._staging = {}
@@ -559,6 +736,7 @@ class ServingEngine:
         """Drive the engine until queue, arrivals and slots are empty."""
         self._ensure_compiled()
         guard = 0
+        spins = 0
         self._running = True
         try:
             while True:
@@ -573,22 +751,47 @@ class ServingEngine:
                                            self._arrivals[0][0])
                         continue
                     if self._sched.depth:
-                        if not admitted:  # all slots free, none admitted
+                        # all slots free, none admitted: tolerate ONE
+                        # spin — a concurrent submit (the replica
+                        # server's handler threads) can land between
+                        # _admit_ready and the depth check; a request
+                        # that truly cannot fit fails again next pass
+                        spins += 1
+                        if spins > 1:
                             raise MXNetError(
                                 "serving queue non-empty but no request "
                                 "admissible (pool/config too small?)")
                         continue
                     break
-                burst = self._ensure_pages(self._stream_every)
+                spins = 0
+                spec = self._spec_k > 0 and self._cache is not None
+                want = self._spec_k + 1 if spec else self._stream_every
+                burst = self._ensure_pages(want)
                 # request ids decoding THIS burst, captured before
                 # _consume can evict finished ones
                 burst_ids = [m.req.id for m in self._slots
                              if m is not None and not m.done]
                 t_burst0 = time.perf_counter()
-                handles = [self._dispatch_step() for _ in range(burst)]
-                self._book_pending_compile()
-                t_stream0 = time.perf_counter()
-                self._consume(handles)
+                if spec and burst == self._spec_k + 1:
+                    # one ragged verify dispatch per boundary: draft
+                    # proposes K, the target checks all K (+ bonus) in
+                    # ONE compiled step; per-slot accepted counts are
+                    # device values
+                    self._ensure_verify()
+                    handle, counts_dev = self._dispatch_spec()
+                    self._book_pending_compile()
+                    t_stream0 = time.perf_counter()
+                    self._consume_spec(handle, counts_dev)
+                    burst = self._spec_k + 1  # guard accounting
+                else:
+                    # plain path (also the fallback when pool pressure
+                    # or a near-budget request shrinks the burst below
+                    # the verify window)
+                    handles = [self._dispatch_step()
+                               for _ in range(burst)]
+                    self._book_pending_compile()
+                    t_stream0 = time.perf_counter()
+                    self._consume(handles)
                 t_stream1 = time.perf_counter()
                 # per-request trace spans at BURST cadence, never per
                 # token (docs/OBSERVABILITY.md §Serving traces): one
@@ -682,14 +885,48 @@ class ServingEngine:
         pages, rows = page_coords(table, pos, self._ps)
         extra = {k: state[k] for k in self._extra_names}
         pools = [state[k] for k in self._pool_names]
-        nxt, new_extra, new_pools = self._adapter.decode(
-            F, tok, pos, table, keep, pages, rows, lengths, extra, pools)
         new_state = dict(state)
+        if not self._sampling:
+            # the original greedy body, op-for-op (the parity-pinned
+            # default: same trace, same AOT fingerprint)
+            nxt, new_extra, new_pools = self._adapter.decode(
+                F, tok, pos, table, keep, pages, rows, lengths, extra,
+                pools)
+        else:
+            logits, new_extra, new_pools = self._adapter.decode_logits(
+                F, tok, pos, table, keep, pages, rows, lengths, extra,
+                pools)
+            nxt, new_state["rng"] = self._select_token(F, state, logits)
+            new_extra = self._adapter.advance_extra(F, new_extra, nxt,
+                                                    pos)
         new_state["tok"] = nxt.reshape(self._S, 1)
         new_state["pos"] = pos + 1
         new_state.update(new_extra)
         new_state.update(dict(zip(self._pool_names, new_pools)))
         return (nxt,) + tuple(new_state[k] for k in self._names)
+
+    def _select_token(self, F, state, logits):
+        """Traced token selection under sampling.  Slots with
+        temperature 0 take the EXACT argmax-over-log-softmax op sequence
+        the greedy body traces — ``where`` selects per slot, so a greedy
+        request in a sampling engine stays bitwise identical to the
+        greedy engine (tests/test_serving_sampling).  Sampling slots
+        take gumbel-argmax over the temperature/top-k/top-p-filtered
+        logits, with per-slot RNG keys advanced as device state."""
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        greedy = F.cast(F.argmax(logits.log_softmax(axis=-1), axis=-1),
+                        "int32")
+        temp = state["temp"]._data
+        filt = _filter_logits(logits._data, temp, state["topk"]._data,
+                              state["topp"]._data)
+        new_keys, subs = _split_keys(state["rng"]._data, 1)
+        g = _gumbel_rows(subs[:, 0], filt.shape[-1])
+        sampled = jnp.argmax(filt + g, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temp > 0, sampled, greedy._data)
+        return (NDArray(nxt, ctx=self._ctx),
+                NDArray(new_keys, ctx=self._ctx))
 
     def _shape_sig(self, arrays):
         return tuple((tuple(np.shape(a)), str(getattr(a, "dtype", "?")))
@@ -765,6 +1002,194 @@ class ServingEngine:
         self._prefill_run = self._resolve(
             jfn, args, ("prefill", src_row.shape[1]), "serving_prefill")
 
+    # ------------------------------------------------------------------
+    # teacher-forced multi-position bodies: speculative verify + prefix
+    # ingest.  Both unroll K(+1) decode_logits bodies inside ONE jitted
+    # step — per-slot proposal counts / ingest lengths are device
+    # values, so the SAME executable serves every ragged mix (the
+    # ragged-paged-attention property, applied along the position axis).
+    #
+    # KV safety: body j writes position pos+j BEFORE attending lengths
+    # pos+j+1, so rows past a slot's accepted/ingested count hold
+    # teacher-forced garbage — but the next dispatch starts at the
+    # slot's new pos and REWRITES each such row before it is ever
+    # attended (the same invariant the plain decode loop relies on for
+    # freshly-granted pages).  Writes beyond a slot's granted pages
+    # land on the zero table entry -> trash page.
+    # ------------------------------------------------------------------
+    def _chain_logits(self, F, state, feed, steps):
+        """Unroll ``steps`` decode_logits bodies, teacher-forcing
+        ``feed[:, j]`` at position pos+j.  Returns (logits list,
+        final extra, final pools) — trace-time only."""
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        pos, table = state["pos"], state["table"]
+        extra = {k: state[k] for k in self._extra_names}
+        pools = [state[k] for k in self._pool_names]
+        Lmax = self._P * self._ps
+        out = []
+        for j in range(steps):
+            pos_j = pos + j
+            lengths = pos_j + 1
+            keep = NDArray(
+                (jnp.arange(Lmax, dtype=jnp.float32)[None, :]
+                 < lengths._data.astype(jnp.float32)[:, None])
+                .astype(jnp.float32), ctx=self._ctx)
+            pages, rows = page_coords(table, pos_j, self._ps)
+            tok_j = NDArray(feed[:, j:j + 1], ctx=self._ctx)
+            logits, extra, pools = self._adapter.decode_logits(
+                F, tok_j, pos_j, table, keep, pages, rows, lengths,
+                extra, pools)
+            out.append(logits)
+        return out, extra, pools
+
+    def _verify_body(self, nds):
+        """The ("verify", K) executable: teacher-force [tok, d_1..d_K]
+        through K+1 decode bodies, accept the longest draft prefix the
+        target agrees with (argmax equality under greedy; the standard
+        u < p(d) test under sampling), emit a correction/bonus token
+        from the first disagreeing position, and advance per-slot state
+        by the ACCEPTED count — a device value.  Greedy rows are
+        token-for-token the plain decode stream; sampling rows draw
+        from exactly the non-speculative output distribution
+        (accept/resample, Leviathan et al.)."""
+        from .. import ndarray as F
+        from ..ndarray import NDArray
+        import jax
+        import jax.numpy as jnp
+
+        K = self._spec_k
+        S = self._S
+        n_state = len(self._names)
+        state = dict(zip(self._names, nds[:n_state]))
+        draft, nprop = nds[n_state], nds[n_state + 1]
+        tok, pos = state["tok"], state["pos"]
+        d = draft._data                                   # (S, K)
+        feed = jnp.concatenate([tok._data, d], axis=1)    # (S, K+1)
+        logits_l, extra, pools = self._chain_logits(F, state, feed, K + 1)
+        greedy = jnp.stack(
+            [F.cast(F.argmax(lg.log_softmax(axis=-1), axis=-1),
+                    "int32")._data for lg in logits_l], axis=1)  # (S,K+1)
+        kclip = jnp.clip(nprop._data, 0, K)               # (S,)
+        jj = jnp.arange(K, dtype=jnp.int32)[None, :]
+        if self._sampling:
+            temp = state["temp"]._data
+            filt = jnp.stack(
+                [_filter_logits(lg._data, temp, state["topk"]._data,
+                                state["topp"]._data)
+                 for lg in logits_l], axis=1)             # (S, K+1, V)
+            V = filt.shape[-1]
+            new_keys, subs = _split_keys(state["rng"]._data, 2 * K + 1)
+            u = jnp.stack([_uniform_rows(subs[:, j])
+                           for j in range(K)], axis=1) if K else \
+                jnp.zeros((S, 0), jnp.float32)            # (S, K)
+            gum = jnp.stack([_gumbel_rows(subs[:, K + j], V)
+                             for j in range(K + 1)], axis=1)  # (S,K+1,V)
+            probs = jax.nn.softmax(filt, axis=-1)
+            pd = jnp.take_along_axis(
+                probs[:, :K], d[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                          # (S, K)
+            # deterministic draft (q = one point mass): accept w.p. p(d)
+            ok = jnp.where(temp[:, None] > 0, u < pd,
+                           d == greedy[:, :K])
+        else:
+            ok = d == greedy[:, :K]
+        valid = jj < kclip[:, None]
+        accept = jnp.cumprod((ok & valid).astype(jnp.int32), axis=1)
+        a = accept.sum(axis=1).astype(jnp.int32)          # (S,)
+        tau_g = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+        if self._sampling:
+            sampled = jnp.argmax(filt + gum, axis=-1) \
+                .astype(jnp.int32)                        # (S, K+1)
+            # resample on rejection: p' ∝ p with the rejected draft
+            # token removed (q is a point mass, so max(0, p-q)
+            # renormalized is p zeroed at d)
+            onehot = jax.nn.one_hot(d, V, dtype=bool)     # (S, K, V)
+            resampled = jnp.argmax(
+                jnp.where(onehot, -jnp.inf, filt[:, :K]) + gum[:, :K],
+                axis=-1).astype(jnp.int32) if K else sampled[:, :0]
+            resampled = jnp.concatenate(
+                [resampled, sampled[:, K:]], axis=1)      # (S, K+1)
+            rejected = a < kclip  # a < proposals => a real disagreement
+            tau_s = jnp.where(rejected[:, None], resampled, sampled)
+            tau_s = jnp.take_along_axis(tau_s, a[:, None], axis=1)[:, 0]
+            tau = jnp.where(state["temp"]._data > 0, tau_s, tau_g) \
+                .astype(jnp.int32)
+        else:
+            tau = tau_g
+        dpad = jnp.concatenate([d, jnp.zeros((S, 1), jnp.int32)], axis=1)
+        jj1 = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+        tout = jnp.where(jj1 < a[:, None], dpad,
+                         jnp.where(jj1 == a[:, None], tau[:, None], 0)
+                         ).astype(jnp.int32)              # (S, K+1)
+        counts = a + 1
+        new_state = dict(state)
+        new_state["tok"] = NDArray(tau[:, None], ctx=self._ctx)
+        new_state["pos"] = NDArray(pos._data + counts, ctx=self._ctx)
+        if self._sampling:
+            new_state["rng"] = NDArray(new_keys, ctx=self._ctx)
+        new_state.update(extra)
+        new_state.update(dict(zip(self._pool_names, pools)))
+        return ((NDArray(tout, ctx=self._ctx),
+                 NDArray(counts, ctx=self._ctx))
+                + tuple(new_state[k] for k in self._names))
+
+    def _ingest_body(self, nds):
+        """The ("ingest", K) executable: teacher-force up to K prefix
+        tokens per slot into the paged KV cache (per-slot ragged length
+        ``n``; n=0 slots are untouched — their garbage writes land on
+        rows the decode loop rewrites before attending, or on the trash
+        page).  Logits are discarded: ingest exists purely for its KV
+        writes."""
+        from .. import ndarray as F
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+
+        K = self._prefix_chunk
+        n_state = len(self._names)
+        state = dict(zip(self._names, nds[:n_state]))
+        feed, n = nds[n_state], nds[n_state + 1]
+        _, extra, pools = self._chain_logits(F, state, feed._data, K)
+        new_state = dict(state)
+        new_state["pos"] = NDArray(
+            state["pos"]._data + jnp.clip(n._data, 0, K), ctx=self._ctx)
+        new_state.update(extra)
+        new_state.update(dict(zip(self._pool_names, pools)))
+        return tuple(new_state[k] for k in self._names)
+
+    def _ensure_verify(self):
+        if self._vrun is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_compiled()
+        jfn = jax.jit(self._traced(self._verify_body))
+        args = (self._params(),) \
+            + tuple(a._data for a in self._state.values()) \
+            + (jnp.zeros((self._S, self._spec_k), jnp.int32),
+               jnp.zeros((self._S,), jnp.int32))
+        self._vrun = self._resolve(
+            jfn, args, ("verify", self._spec_k, self._ps, self._S),
+            "serving_verify")
+
+    def _ensure_ingest(self):
+        if self._irun is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_compiled()
+        jfn = jax.jit(self._traced(self._ingest_body))
+        args = (self._params(),) \
+            + tuple(a._data for a in self._state.values()) \
+            + (jnp.zeros((self._S, self._prefix_chunk), jnp.int32),
+               jnp.zeros((self._S,), jnp.int32))
+        self._irun = self._resolve(
+            jfn, args, ("ingest", self._prefix_chunk, self._ps, self._S),
+            "serving_ingest")
+
     def _book_pending_compile(self):
         """Book plain-jit compiles AFTER the dispatching burst (the hot
         body never pays the analysis retrace).  Only entries whose first
@@ -803,6 +1228,91 @@ class ServingEngine:
         self._ring.admit(handle)
         return handle
 
+    def _propose(self):
+        """Host-side draft proposals for every live slot: (S, K) int32
+        token matrix + (S,) proposal counts (ragged — 0 for empty/done
+        slots and for requests the draft has nothing for)."""
+        K = self._spec_k
+        draft = np.zeros((self._S, K), np.int32)
+        nprop = np.zeros((self._S,), np.int32)
+        for slot, meta in enumerate(self._slots):
+            if meta is None or meta.done:
+                continue
+            toks = list(self._draft.propose(meta.req,
+                                            meta.req.stream.tokens, K))[:K]
+            if toks:
+                draft[slot, :len(toks)] = toks
+                nprop[slot] = len(toks)
+        return draft, nprop
+
+    def _dispatch_spec(self):
+        """Dispatch ONE compiled verify step (K draft tokens checked +
+        one correction/bonus emitted per slot).  Same no-host-sync
+        contract as _dispatch_step: the (S, K+1) token matrix rides out
+        lazily; the per-slot counts force together with it at the
+        stream boundary."""
+        import jax.numpy as jnp
+
+        draft, nprop = self._propose()
+        self._last_nprop = nprop
+        self._ring.make_room(self._stream_every, wait_span=False)
+        arrays = [a._data for a in self._state.values()]
+        t0 = time.perf_counter()
+        outs = self._vrun(self._params(), *arrays, jnp.asarray(draft),
+                          jnp.asarray(nprop))
+        if "serving_verify" in self._pending_compile:
+            self._pending_compile["serving_verify"].setdefault(
+                "wall_s", time.perf_counter() - t0)
+        tout, counts = outs[0], outs[1]
+        from ..ndarray import NDArray
+
+        for name, arr in zip(self._names, outs[2:]):
+            self._state[name] = NDArray(arr, ctx=self._ctx)
+        self._step_n += 1
+        handle = AsyncResult(tout, step=self._step_n,
+                             executor="ServingEngine", ring=self._ring)
+        self._ring.admit(handle)
+        return handle, counts
+
+    def _consume_spec(self, handle, counts_dev):
+        """Stream boundary for a verify dispatch: one (S, K+1) token
+        matrix + per-slot emitted counts land together.  Row layout per
+        slot: the accepted draft tokens, then the correction/bonus
+        token, then padding."""
+        tout = handle.asnumpy()
+        counts = np.asarray(counts_dev)
+        proposed = int(self._last_nprop.sum()) \
+            if self._last_nprop is not None else 0
+        accepted = 0
+        for slot, meta in enumerate(self._slots):
+            if meta is None:
+                continue
+            c = int(counts[slot])
+            meta.pos += c  # device pos advanced by the accepted count
+            if meta.done:
+                continue
+            req = meta.req
+            accepted += max(0, c - 1)
+            for i in range(c):
+                tok = int(tout[slot, i])
+                req.stream.append(tok)
+                if req.t_first_token is None:
+                    req.t_first_token = time.perf_counter()
+                if tok == req.eos_id:
+                    meta.done = True
+                    req.stream.finish("eos")
+                    break
+                if len(req.stream) >= req.max_new_tokens:
+                    meta.done = True
+                    req.stream.finish("length")
+                    break
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        telemetry.record_spec_verify(proposed=proposed, accepted=accepted)
+        for slot, meta in enumerate(self._slots):
+            if meta is not None and meta.done:
+                self._evict(slot, meta)
+
     # ------------------------------------------------------------------
     # host-side scheduling (stream boundaries only)
     # ------------------------------------------------------------------
@@ -818,11 +1328,20 @@ class ServingEngine:
         pages_free = (self._cache.pages_free if self._cache is not None
                       else len(free))
         ready = self._sched.pop_ready(len(free), pages_free, self._ps)
-        for slot, req in zip(free, ready):
-            self._admit(slot, req)
-        return len(ready)
+        n = 0
+        for i, (slot, req) in enumerate(zip(free, ready)):
+            if self._admit(slot, req):
+                n += 1
+                continue
+            # pool too tight for this request's prefix right now: it
+            # went back to the queue head inside _admit; park the rest
+            # behind it in order (requeue prepends, so walk backwards)
+            for r in reversed(ready[i + 1:]):
+                self._sched.requeue(r)
+            break
+        return n
 
-    def _admit(self, slot: int, req: Request):
+    def _admit(self, slot: int, req: Request) -> bool:
         st = self._state
         # the queue leg of the request-id span tree: queue-start ->
         # admit, recorded retroactively from the scheduler's SLO stamps
@@ -834,31 +1353,259 @@ class ServingEngine:
                                   req.t_admit, request_id=req.id)
         src = self._adapter.prefill_src(req)
         if src is not None:
-            self._ensure_prefill(src)
-            import jax.numpy as jnp
-
-            t0 = time.perf_counter()
-            outs = self._prefill_run(self._params(), jnp.asarray(src))
-            t1 = time.perf_counter()
-            # prefill_ms is DISPATCH wall (async queueing, like step
-            # events — see telemetry.record_step's contract)
-            req.prefill_ms = round((t1 - t0) * 1e3, 3)
-            if telemetry.spans_enabled():
-                telemetry.record_span("serve_prefill", t0, t1,
-                                      request_id=req.id)
-            if "serving_prefill" in self._pending_compile:
-                self._pending_compile["serving_prefill"].setdefault(
-                    "wall_s", time.perf_counter() - t0)
-                self._book_pending_compile()
-            from ..ndarray import NDArray
-
-            for name, arr in zip(self._prefill_names, outs):
-                st[name][slot] = NDArray(arr, ctx=self._ctx)[0]
+            self._prefill_into(slot, req, src)
         st["tok"][slot, 0] = req.bos_id
         st["pos"][slot] = 0
+        if self._sampling:
+            self._install_sampling(slot, req)
         self._adapter.install(st, slot, req)
         self._admit_seq += 1
-        self._slots[slot] = _Active(req, self._admit_seq)
+        meta = _Active(req, self._admit_seq)
+        self._slots[slot] = meta
+        if req.prefix.size:
+            if not self._install_prefix(slot, meta, req):
+                self._rollback_admit(slot, req)
+                return False
+        return True
+
+    def _prefill_into(self, slot: int, req: Request, src) -> None:
+        """Run (or reuse) the prefill executable for one admission.
+        With the prefix cache on, identical prefill inputs hit a cached
+        device copy of the output rows — the 'prefill once' half of
+        prefix reuse (the encoder memory for a repeated source)."""
+        st = self._state
+        names = list(self._adapter.prefill_names)
+        pkey = (prefix_key("prefill", src)
+                if self._prefix is not None else None)
+        if pkey is not None:
+            e = self._prefix.get(pkey, self._weight_generation)
+            if e is not None:
+                for name in names:
+                    st[name][slot] = e["payload"]["rows"][name]
+                req.prefill_ms = 0.0
+                telemetry.record_serve_prefix(
+                    kind="prefill", hit=True, tokens=int(req.tokens.size))
+                return
+        self._ensure_prefill(src)
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        outs = self._prefill_run(self._params(), jnp.asarray(src))
+        t1 = time.perf_counter()
+        # prefill_ms is DISPATCH wall (async queueing, like step
+        # events — see telemetry.record_step's contract)
+        req.prefill_ms = round((t1 - t0) * 1e3, 3)
+        if telemetry.spans_enabled():
+            telemetry.record_span("serve_prefill", t0, t1,
+                                  request_id=req.id)
+        if "serving_prefill" in self._pending_compile:
+            self._pending_compile["serving_prefill"].setdefault(
+                "wall_s", time.perf_counter() - t0)
+            self._book_pending_compile()
+        from ..ndarray import NDArray
+
+        rows = {}
+        for name, arr in zip(self._prefill_names, outs):
+            row = NDArray(arr, ctx=self._ctx)[0]
+            st[name][slot] = row
+            rows[name] = row
+        if pkey is not None:
+            for d in self._prefix.put(pkey, "prefill",
+                                      self._weight_generation,
+                                      {"rows": rows, "owner": None}):
+                self._release_prefix_entry(d)
+            telemetry.record_serve_prefix(
+                kind="prefill", hit=False, tokens=int(req.tokens.size))
+
+    def _install_sampling(self, slot: int, req: Request) -> None:
+        """Per-slot sampling state at admission.  The RNG key is a pure
+        function of the request's seed — decoding is reproducible across
+        restarts, slot assignments and recompute-preemptions (the key
+        re-derives identically on re-admission)."""
+        import jax
+
+        st = self._state
+        st["temp"][slot] = req.temperature
+        st["topk"][slot] = req.top_k
+        st["topp"][slot] = req.top_p
+        if req.seed is None:
+            # stamped on the request so a preemption re-derives the
+            # same stream (deterministic re-decode, like greedy)
+            req.seed = int.from_bytes(os.urandom(4), "little")
+        st["rng"][slot] = np.asarray(jax.random.PRNGKey(req.seed))
+
+    # ------------------------------------------------------------------
+    # prefix cache: COW page forks + teacher-forced ingest
+    # ------------------------------------------------------------------
+    def _install_prefix(self, slot: int, meta: _Active,
+                        req: Request) -> bool:
+        """Put the request's forced decoder prefix into the slot's KV
+        pages: fork a cached entry's pages (hit) or teacher-force the
+        tokens through the ("ingest", K) executable and register the
+        result (miss).  Returns False when the pool cannot hold the
+        prefix even after dropping cache entries — the caller rolls the
+        admission back."""
+        T = int(req.prefix.size)
+        key = (prefix_key(req.tokens, req.bos_id, req.prefix)
+               if self._prefix is not None else None)
+        if key is not None:
+            e = self._prefix.get(key, self._weight_generation)
+            if e is not None and self._fork_from_entry(slot, e, req):
+                meta.pos = T
+                telemetry.record_serve_prefix(kind="pages", hit=True,
+                                              tokens=T)
+                return True
+        need = pages_for(T, self._ps) - len(self._cache.owned(slot))
+        if not self._alloc_prefix_pages(slot, need):
+            return False
+        self._state["table"][slot] = self._cache.table_row(slot, self._P)
+        self._ingest_prefix(slot, req)
+        meta.pos = T
+        if key is not None:
+            self._register_prefix(slot, key, T)
+            telemetry.record_serve_prefix(kind="pages", hit=False,
+                                          tokens=T)
+        return True
+
+    def _fork_from_entry(self, slot: int, e: dict, req: Request) -> bool:
+        """Copy-on-write fork: adopt the entry's FULL pages (shared,
+        refcounted — never written again: the slot's first write lands
+        at pos >= prefix_len) and device-copy the partial tail page into
+        a private page the slot may keep writing.  Bitwise-identical
+        continuation: the forked slot decodes over the exact pool rows
+        the cold ingest produced."""
+        st = self._state
+        T = int(e["payload"]["len"])
+        pages = e["payload"]["pages"]
+        full, tail = T // self._ps, T % self._ps
+        if full:
+            self._cache.adopt(slot, pages[:full])
+        if tail:
+            got = self._cache.alloc(slot, 1)
+            if got is None and self._drop_one_prefix_entry():
+                got = self._cache.alloc(slot, 1)
+            if not got:
+                self._cache.free_slot(slot)  # release the adoption
+                st["table"][slot] = 0
+                return False
+            for name in self._pool_names:
+                st[name][got[0]] = st[name][pages[full]]
+        st["table"][slot] = self._cache.table_row(slot, self._P)
+        st["pos"][slot] = T
+        st["tok"][slot, 0] = int(req.prefix[-1])
+        return True
+
+    def _register_prefix(self, slot: int, key: str, T: int) -> None:
+        """After a cold ingest: share the slot's full prefix pages into
+        a cache entry and give the entry a private COPY of the partial
+        tail page (the donor keeps writing its own tail at pos >= T —
+        the entry's copy must stay frozen)."""
+        full, tail = T // self._ps, T % self._ps
+        self._admit_seq += 1  # unique owner key per registration
+        ek = f"prefix:{key[:16]}:{self._admit_seq}"
+        slot_pages = self._cache.owned(slot)
+        entry_pages = list(slot_pages[:full])
+        if full:
+            self._cache.adopt(ek, entry_pages)
+        if tail:
+            got = self._cache.alloc(ek, 1)
+            if got is None:
+                # no room for the tail copy: don't register a partial
+                # entry (a fork would miss the tail rows)
+                self._cache.free_slot(ek)
+                return
+            st = self._state
+            for name in self._pool_names:
+                st[name][got[0]] = st[name][slot_pages[full]]
+            entry_pages.append(got[0])
+        for d in self._prefix.put(key, "pages", self._weight_generation,
+                                  {"owner": ek, "pages": entry_pages,
+                                   "len": T}):
+            self._release_prefix_entry(d)
+
+    def _ingest_prefix(self, slot: int, req: Request) -> None:
+        """Teacher-force [bos, p_1..p_{T-1}] into the slot's KV pages in
+        ("ingest", K)-sized chunks; afterwards the slot sits at pos=T
+        with tok=p_T — exactly the state T forced greedy decode steps
+        would have produced, so the continuation is bitwise identical
+        to decoding the prefix the slow way."""
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+
+        self._ensure_ingest()
+        T = int(req.prefix.size)
+        feed_seq = np.concatenate(
+            [[req.bos_id], req.prefix[:-1]]).astype(np.int32)
+        Kc = self._prefix_chunk
+        t0 = time.perf_counter()
+        done = 0
+        while done < T:
+            n = min(Kc, T - done)
+            feed = np.zeros((self._S, Kc), np.int32)
+            feed[slot, :n] = feed_seq[done:done + n]
+            nvec = np.zeros((self._S,), np.int32)
+            nvec[slot] = n
+            arrays = [a._data for a in self._state.values()]
+            outs = self._irun(self._params(), *arrays,
+                              jnp.asarray(feed), jnp.asarray(nvec))
+            if "serving_ingest" in self._pending_compile:
+                self._pending_compile["serving_ingest"].setdefault(
+                    "wall_s", time.perf_counter() - t0)
+                self._book_pending_compile()
+            for name, arr in zip(self._names, outs):
+                self._state[name] = NDArray(arr, ctx=self._ctx)
+            done += n
+        self._state["tok"][slot, 0] = int(req.prefix[-1])
+        if telemetry.spans_enabled():
+            telemetry.record_span("serve_ingest", t0,
+                                  time.perf_counter(),
+                                  request_id=req.id, tokens=T)
+
+    def _alloc_prefix_pages(self, slot: int, n: int) -> bool:
+        """Allocate ``n`` pages for a prefix, dropping LRU cache entries
+        under pool pressure (evict-before-preempt: cached prefixes are
+        recomputable, live requests cost a full re-decode)."""
+        if n <= 0:
+            return True
+        while self._cache.alloc(slot, n) is None:
+            if not self._drop_one_prefix_entry():
+                return False
+        return True
+
+    def _drop_one_prefix_entry(self) -> bool:
+        if self._prefix is None:
+            return False
+        e = self._prefix.pop_lru("pages")
+        if e is None:
+            return False
+        self._release_prefix_entry(e)
+        telemetry.record("serve_prefix_evict", executor="ServingEngine",
+                         key=e["key"][:12], tokens=e["payload"]["len"])
+        return True
+
+    def _release_prefix_entry(self, e: dict) -> None:
+        owner = e["payload"].get("owner")
+        if owner is not None and self._cache is not None:
+            self._cache.free_slot(owner)
+
+    def _rollback_admit(self, slot: int, req: Request) -> None:
+        """Undo a partially-completed admission (prefix didn't fit):
+        the slot reads empty again and the request parks at the queue
+        head, exactly like a preemption before any decode."""
+        st = self._state
+        if self._cache is not None:
+            self._cache.free_slot(slot)
+        st["table"][slot] = 0
+        st["pos"][slot] = 0
+        st["tok"][slot] = 0
+        for name in self._extra_names:
+            st[name][slot] = 0
+        for name in self._samp_names:
+            st[name][slot] = 0
+        self._slots[slot] = None
+        req.t_admit = None
+        req.prefill_ms = 0.0
+        self._sched.requeue(req)
 
     def _ensure_pages(self, burst: int) -> int:
         """Grow page tables so every active, unfinished slot can decode
@@ -875,6 +1622,10 @@ class ServingEngine:
             feas = self._grow_tables(burst)
             if feas > 0:
                 return feas
+            # evict-before-preempt: cached prefixes are cheap to rebuild
+            # (one ingest), a live request costs a full re-decode
+            if self._drop_one_prefix_entry():
+                continue
             cands = [(m.seq, slot, m) for slot, m in enumerate(self._slots)
                      if m is not None and not m.done]
             if len(cands) <= 1:
@@ -920,6 +1671,8 @@ class ServingEngine:
         st["table"][slot] = 0
         st["pos"][slot] = 0
         for name in self._extra_names:
+            st[name][slot] = 0
+        for name in self._samp_names:
             st[name][slot] = 0
         req = meta.req
         req.stream.tokens.clear()
@@ -970,6 +1723,8 @@ class ServingEngine:
         st["pos"][slot] = 0
         for name in self._extra_names:
             st[name][slot] = 0
+        for name in self._samp_names:
+            st[name][slot] = 0
         req = meta.req
         now = time.perf_counter()
         decode_ms = max(0.0, (now - req.t_admit) * 1e3
@@ -987,3 +1742,93 @@ class ServingEngine:
             request_id=req.id, reason=req.stream.finish_reason,
             precision=self._precision)
         self._slots[slot] = None
+
+    # ------------------------------------------------------------------
+    # introspection + batched beam serving
+    # ------------------------------------------------------------------
+    def statusz_snapshot(self) -> dict:
+        """Jax-free engine status for the serving front door's /statusz
+        (plain attribute reads — safe from the replica's HTTP handler
+        threads while the run loop decodes)."""
+        snap = {
+            "slots": self._S,
+            "active_slots": sum(1 for m in self._slots if m is not None),
+            "queue_depth": self._sched.depth,
+            "queue_bound": self._sched.bound,
+            "steps": self._step_n,
+            "weight_generation": self._weight_generation,
+            "precision": self._precision,
+            "sampling": bool(self._sampling),
+            "spec_k": self._spec_k,
+            "max_len": self._max_len,
+        }
+        if self._cache is not None:
+            snap["pages_free"] = self._cache.pages_free
+            snap["pages_total"] = self._cache.num_pages
+        if self._prefix is not None:
+            snap["prefix_entries"] = len(self._prefix)
+            snap["prefix_hits"] = self._prefix.hits
+            snap["prefix_misses"] = self._prefix.misses
+        if self._spec_k:
+            snap["spec_proposed"] = self._spec_proposed
+            snap["spec_accepted"] = self._spec_accepted
+        return snap
+
+    def serve_beam(self, requests, beam_size: int = 4, alpha: float = 0.6,
+                   sync_every: int = 8) -> Dict[str, np.ndarray]:
+        """Batched beam serving: decode ``requests`` with the model's
+        device-resident beam search (``translate`` — beam bookkeeping
+        stays on device, host syncs every ``sync_every`` steps) in ONE
+        batch per (bos, eos) group, and return {id: tokens} trimmed the
+        same way the greedy engine streams them (bos dropped, cut just
+        after eos).  Quality-first counterpart to :meth:`serve`: no
+        continuous batching or mid-flight joins, but each request gets a
+        beam_size-wide search instead of a single greedy/sampled lane."""
+        model = getattr(self._adapter, "model", None)
+        if model is None or not hasattr(model, "translate"):
+            raise MXNetError(
+                "serve_beam needs an adapter exposing .model with "
+                "translate() (the seq2seq TransformerAdapter)")
+        from ..ndarray import array as nd_array
+
+        requests = list(requests)
+        groups: Dict[tuple, List[Request]] = {}
+        for req in requests:
+            if req.temperature > 0 or req.prefix.size:
+                raise MXNetError(
+                    f"request {req.id}: beam serving is search, not "
+                    "sampling — temperature/prefix don't apply")
+            groups.setdefault((req.bos_id, req.eos_id), []).append(req)
+        out: Dict[str, np.ndarray] = {}
+        for (bos, eos), grp in groups.items():
+            t0 = time.perf_counter()
+            src_w = max(int(r.tokens.size) for r in grp)
+            src = np.zeros((len(grp), src_w), np.int32)
+            for i, r in enumerate(grp):
+                src[i, :r.tokens.size] = r.tokens
+            max_new = max(r.max_new_tokens for r in grp)
+            hyp = model.translate(
+                nd_array(src, ctx=self._ctx, dtype="int32"), bos_id=bos,
+                eos_id=eos, max_len=max_new + 1, beam_size=beam_size,
+                alpha=alpha, sync_every=sync_every,
+                page_size=self._ps if self._cache is not None else None)
+            t1 = time.perf_counter()
+            for i, r in enumerate(grp):
+                toks = list(hyp[i, 1:])  # row 0 is bos
+                if eos in toks:
+                    toks = toks[:toks.index(eos) + 1]
+                toks = toks[:r.max_new_tokens]
+                for t in toks:
+                    r.stream.append(t)
+                r.stream.finish("eos" if (toks and toks[-1] == eos)
+                                else "length")
+                out[r.id] = r.stream.asarray()
+                telemetry.record_serve_request(
+                    queue_wait_ms=0.0, prefill_ms=0.0,
+                    decode_ms=round((t1 - t0) * 1e3, 3),
+                    tokens=len(toks),
+                    ttft_ms=round((t1 - t0) * 1e3, 3),
+                    total_ms=round((t1 - t0) * 1e3, 3),
+                    request_id=r.id, reason=r.stream.finish_reason,
+                    precision=self._precision, beam=beam_size)
+        return out

@@ -23,11 +23,21 @@ Two layers live here:
     the gathered dense view, so paged decode is **bitwise identical** to
     the dense-cache decode for the same tokens (asserted by
     tests/test_serving.py).
-  * ``PagedKVCache`` — the host-side allocator (free list + per-slot
-    page ownership) and pool factory.  Page 0 is reserved as the trash
-    page: empty slots' all-zero table rows route their (discarded)
-    writes there, so inactive decode lanes can never corrupt a live
-    request's cache.
+  * ``PagedKVCache`` — the host-side allocator (free list + per-owner
+    page ownership + per-page REFCOUNTS) and pool factory.  Page 0 is
+    reserved as the trash page: empty slots' all-zero table rows route
+    their (discarded) writes there, so inactive decode lanes can never
+    corrupt a live request's cache.
+
+Owners are opaque hashable keys: decode slots (ints) and prefix-cache
+entries (strings) share one pool.  ``adopt`` lets a second owner share a
+page another owner already holds (copy-on-write prefix reuse — the
+serving engine's prefix cache, docs/SERVING.md §Prefix cache): the page
+returns to the free list only when its LAST owner releases it.  A shared
+page must never be written through — the engine guarantees this by
+sharing only FULL pages (a forked request's first write lands at
+``pos >= prefix_len``, inside a private page), and by giving the cache
+entry its own COPY of any partially-filled tail page.
 
 The fused alternative to the gather (``ops.pallas.paged_attention``)
 never materialises the dense view; see ``PagedStepCache(fused=True)``.
@@ -205,6 +215,7 @@ class PagedKVCache:
         # LIFO free list: recently-freed (cache-warm) pages reused first
         self._free: List[int] = list(range(1, self.num_pages))
         self._owned: dict = {}
+        self._refs: dict = {}  # page -> owner count (COW sharing)
 
     @property
     def pages_free(self) -> int:
@@ -212,6 +223,10 @@ class PagedKVCache:
 
     def owned(self, slot) -> List[int]:
         return list(self._owned.get(slot, ()))
+
+    def refcount(self, page: int) -> int:
+        """How many owners hold ``page`` (0 = free/never granted)."""
+        return self._refs.get(int(page), 0)
 
     def alloc(self, slot, n_pages: int) -> Optional[List[int]]:
         """Grant ``n_pages`` more pages to ``slot`` (all-or-nothing).
@@ -226,15 +241,44 @@ class PagedKVCache:
             return None
         got = [self._free.pop() for _ in range(n_pages)]
         self._owned.setdefault(slot, []).extend(got)
+        for p in got:
+            self._refs[p] = 1
         return got
 
+    def adopt(self, owner, pages) -> None:
+        """Add ``owner`` as a co-owner of already-granted ``pages``
+        (copy-on-write sharing: a prefix-cache hit forks a page table by
+        adopting the entry's full pages instead of re-prefilling them).
+        Each page's refcount bumps by one; it returns to the free list
+        only when the last owner releases it.  Adopting a page nobody
+        owns is a bookkeeping bug and raises."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if self._refs.get(p, 0) <= 0:
+                raise MXNetError(
+                    f"adopt: page {p} is not currently owned — a free "
+                    "page cannot be shared (allocator bookkeeping bug)")
+        self._owned.setdefault(owner, []).extend(pages)
+        for p in pages:
+            self._refs[p] += 1
+
     def free_slot(self, slot) -> int:
-        """Return every page ``slot`` owns to the pool (request finished
-        / evicted — the continuous-batching moment waiting requests are
-        waiting for).  Returns how many pages came back."""
+        """Release every page ``slot`` owns (request finished / evicted /
+        prefix-cache entry dropped).  Pages whose refcount hits zero
+        return to the pool — shared (adopted) pages survive until their
+        last owner lets go.  Returns how many pages actually came back
+        to the free list."""
         pages = self._owned.pop(slot, [])
-        self._free.extend(pages)
-        return len(pages)
+        freed = 0
+        for p in pages:
+            left = self._refs.get(p, 1) - 1
+            if left <= 0:
+                self._refs.pop(p, None)
+                self._free.append(p)
+                freed += 1
+            else:
+                self._refs[p] = left
+        return freed
 
     def capacity_rows(self, slot) -> int:
         """How many cache rows the slot's granted pages can hold."""

@@ -1,0 +1,647 @@
+"""Serving front door: per-replica HTTP servers + a multi-replica
+router (docs/SERVING.md §Front door).
+
+Two stdlib-only pieces (``http.server`` + daemon threads, the
+metrics_server pattern — no framework, nothing to install):
+
+  * :class:`ReplicaServer` fronts ONE :class:`~.engine.ServingEngine`:
+    it owns a dedicated engine-driver thread (the ONLY thread that ever
+    touches jax — HTTP handlers just build :class:`~.scheduler.Request`
+    objects, submit, and poll the request's ``TokenStream.finished``
+    flag, so the handler code is jax-free by construction and mxlint's
+    reachability check keeps it that way).  It advertises itself by
+    writing ``serve-port-<rank>.json`` next to the metrics portfiles
+    (atomic tmp+rename; tools/launch.py cleans them up the same way).
+
+  * :class:`Router` is the client-facing load balancer: it discovers
+    replicas from those portfiles, health-polls their ``/healthz``,
+    dispatches each ``/generate`` to the healthy replica with the
+    fewest outstanding requests, and pins ``session`` ids to a replica
+    (affinity keeps a conversation's prefix-cache pages hot on one
+    engine — the COW prefix cache is per-replica).  A replica that
+    drops mid-request is marked dead and the request FAILS OVER to the
+    next healthy replica (decoding restarts — greedy/seeded decode is
+    deterministic, so the client sees identical tokens, just later).
+    ``/admin/drain`` takes a replica out of rotation gracefully
+    (in-flight requests finish; health polling re-adds it after
+    ``/admin/undrain``) — composing with ``--elastic`` rescale and
+    weight hot-swap: drain, swap/restart, undrain, no dropped requests.
+
+Routes (replica): ``POST /generate``, ``GET /statusz``, ``GET
+/healthz``, ``POST /admin/drain``, ``POST /admin/undrain``.
+Routes (router): the same, plus drain/undrain take ``?rank=N``.
+
+``/generate`` body (JSON): ``prompt`` (list of token ids, required),
+``max_new_tokens``, ``bos_id``/``eos_id`` (default to the replica's
+configured pair), ``temperature``/``top_k``/``top_p``/``seed``
+(defaults from ``MX_SERVE_TEMPERATURE`` / ``MX_SERVE_TOP_K`` /
+``MX_SERVE_TOP_P`` — applied at this HTTP layer, never inside the
+engine), ``prefix`` (forced decoder prefix; prefix-cache candidate),
+``session`` (router affinity key), ``timeout_s``.  Response:
+``{"request_id", "tokens", "finish_reason", "replica", ...}``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .. import telemetry
+from ..base import MXNetError
+from .scheduler import Request
+
+__all__ = ["ReplicaServer", "Router", "serve_portfile_path",
+           "discover_replicas"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving.router")
+
+
+def serve_portfile_path(directory: str, rank_id: int) -> str:
+    """Per-replica portfile path (mirrored in tools/launch.py, which
+    must stay importable without jax/mxnet_tpu — keep in sync)."""
+    return os.path.join(directory, f"serve-port-{rank_id}.json")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def discover_replicas(directory: str) -> List[dict]:
+    """Parse every ``serve-port-*.json`` in ``directory`` (torn/garbage
+    files are skipped — the atomic rename means they're transient)."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("serve-port-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                p = json.load(f)
+            out.append({"rank": int(p["rank"]), "host": str(p["host"]),
+                        "port": int(p["port"])})
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def _sampling_defaults() -> dict:
+    """Fleet-wide sampling defaults, applied when a /generate body omits
+    the field (docs/SERVING.md §Sampling) — an explicit body value
+    always wins, and ``temperature: 0`` still means greedy."""
+    return {"temperature": _env_float("MX_SERVE_TEMPERATURE", 0.0),
+            "top_k": _env_int("MX_SERVE_TOP_K", 0),
+            "top_p": _env_float("MX_SERVE_TOP_P", 1.0)}
+
+
+def _send(handler, code: int, body, ctype: str = "application/json"):
+    if not isinstance(body, (str, bytes)):
+        body = json.dumps(body) + "\n"
+    payload = body if isinstance(body, bytes) \
+        else body.encode("utf-8", "replace")
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
+def _read_json_body(handler) -> dict:
+    n = int(handler.headers.get("Content-Length") or 0)
+    raw = handler.rfile.read(n) if n else b"{}"
+    body = json.loads(raw.decode("utf-8"))
+    if not isinstance(body, dict):
+        raise ValueError("body must be a JSON object")
+    return body
+
+
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    """One replica's route handler.  mxlint JAX_FREE_ENTRIES starts its
+    reachability scan here: handlers submit Requests and poll host-side
+    stream flags — they never import jax, never force a device sync
+    (the engine-driver thread owns the device)."""
+
+    server_version = "mxnet-tpu-replica/1"
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        rep: "ReplicaServer" = self.server.replica  # type: ignore
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if route in ("/", "/statusz"):
+            _send(self, 200, rep.statusz())
+        elif route == "/healthz":
+            snap = rep.healthz()
+            _send(self, 200 if snap["ok"] else 503, snap)
+        else:
+            _send(self, 404, {"error": f"no such route {route!r}"})
+
+    def do_POST(self):  # noqa: N802
+        rep: "ReplicaServer" = self.server.replica  # type: ignore
+        route = self.path.split("?", 1)[0].rstrip("/")
+        if route == "/generate":
+            self._generate(rep)
+        elif route == "/admin/drain":
+            rep.drain()
+            _send(self, 200, {"draining": True, "rank": rep.rank})
+        elif route == "/admin/undrain":
+            rep.undrain()
+            _send(self, 200, {"draining": False, "rank": rep.rank})
+        else:
+            _send(self, 404, {"error": f"no such route {route!r}"})
+
+    def _generate(self, rep: "ReplicaServer"):
+        if rep.draining:
+            _send(self, 503, {"error": "replica draining",
+                              "rank": rep.rank})
+            return
+        try:
+            body = _read_json_body(self)
+        except (ValueError, UnicodeDecodeError) as e:
+            _send(self, 400, {"error": f"bad JSON body: {e}"})
+            return
+        try:
+            result = rep.generate(body)
+        except MXNetError as e:
+            # backpressure (queue full) and validation errors are the
+            # client's 4xx/503, never a replica crash
+            code = 503 if "queue full" in str(e) else 400
+            _send(self, code, {"error": str(e), "rank": rep.rank})
+            return
+        except TimeoutError as e:
+            _send(self, 504, {"error": str(e), "rank": rep.rank})
+            return
+        _send(self, 200, result)
+
+    def log_message(self, fmt, *args):
+        _LOG.debug("%s %s", self.address_string(), fmt % args)
+
+
+class ReplicaServer:
+    """HTTP front for one ServingEngine (one replica of the fleet).
+
+    The engine runs on a private driver thread; handler threads only
+    submit/poll.  ``bos_id``/``eos_id`` are the defaults a /generate
+    body may override per request."""
+
+    def __init__(self, engine, bos_id: int, eos_id: int,
+                 port: Optional[int] = None, host: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 directory: Optional[str] = None):
+        self.engine = engine
+        self.bos_id = int(bos_id)
+        self.eos_id = int(eos_id)
+        self.rank = telemetry.rank() if rank is None else int(rank)
+        self._host = host if host is not None \
+            else os.environ.get("MX_SERVE_HOST", "127.0.0.1")
+        if port is None:
+            base = _env_int("MX_SERVE_PORT", 0)
+            port = base + self.rank if base > 0 else 0
+        self._bind_port = int(port)
+        self._dir = directory if directory is not None \
+            else os.environ.get("MX_TELEMETRY_DIR")
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._drive_thread: Optional[threading.Thread] = None
+        self._portfile: Optional[str] = None
+        self._wake = threading.Condition()
+        self._stop = False
+        self.draining = False
+        self._outstanding = 0
+        self._error: Optional[str] = None
+        self.port = 0
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        server = ThreadingHTTPServer((self._host, self._bind_port),
+                                     _ReplicaHandler)
+        server.daemon_threads = True
+        server.replica = self  # type: ignore[attr-defined]
+        self._server = server
+        self.port = server.server_address[1]
+        self._http_thread = threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name=f"mx-serve-http-{self.rank}")
+        self._http_thread.start()
+        self._drive_thread = threading.Thread(
+            target=self._drive, daemon=True,
+            name=f"mx-serve-engine-{self.rank}")
+        self._drive_thread.start()
+        self._portfile = self._write_portfile()
+        _LOG.info("replica %d serving on %s:%d", self.rank, self._host,
+                  self.port)
+        return self
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._drive_thread is not None:
+            self._drive_thread.join(timeout=10.0)
+        if self._portfile:
+            try:
+                os.unlink(self._portfile)
+            except OSError:
+                pass
+            self._portfile = None
+
+    def _write_portfile(self) -> Optional[str]:
+        if not self._dir:
+            return None
+        path = serve_portfile_path(self._dir, self.rank)
+        host = self._host
+        payload = {"rank": self.rank, "port": self.port,
+                   "host": "127.0.0.1" if host in ("0.0.0.0", "::", "")
+                   else host,
+                   "pid": os.getpid(), "time": round(time.time(), 3)}
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)  # the router never sees a torn file
+        except OSError as e:
+            _LOG.warning("serve portfile write to %s failed: %s", path, e)
+            return None
+        return path
+
+    # ---- engine driver (the only jax-touching thread) ----------------
+    def _drive(self) -> None:
+        while True:
+            with self._wake:
+                while not self._stop and not self.engine._sched.depth:
+                    self._wake.wait(timeout=0.5)
+                if self._stop:
+                    return
+            try:
+                self.engine.run()
+            except Exception as e:  # noqa: BLE001 — surface via /healthz
+                self._error = f"{type(e).__name__}: {e}"
+                _LOG.exception("replica %d engine loop died", self.rank)
+                return
+
+    # ---- handler-side operations (jax-free) --------------------------
+    def generate(self, body: dict) -> dict:
+        defaults = _sampling_defaults()
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            raise MXNetError("/generate body needs a non-empty 'prompt' "
+                             "list of token ids")
+        req = Request(
+            prompt,
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+            bos_id=int(body.get("bos_id", self.bos_id)),
+            eos_id=int(body.get("eos_id", self.eos_id)),
+            request_id=body.get("request_id"),
+            temperature=float(body.get("temperature",
+                                       defaults["temperature"])),
+            top_k=int(body.get("top_k", defaults["top_k"])),
+            top_p=float(body.get("top_p", defaults["top_p"])),
+            seed=body.get("seed"),
+            prefix=body.get("prefix"),
+            session=body.get("session"))
+        timeout_s = float(body.get("timeout_s", 120.0))
+        self._outstanding += 1
+        try:
+            self.engine.submit(req)
+            with self._wake:
+                self._wake.notify_all()
+            deadline = time.monotonic() + timeout_s
+            while not req.stream.finished:
+                if self._error:
+                    raise MXNetError(
+                        f"replica engine died: {self._error}")
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"request {req.id} not finished after "
+                        f"{timeout_s:.0f}s")
+                time.sleep(0.002)
+        finally:
+            self._outstanding -= 1
+        return {"request_id": req.id,
+                "tokens": [int(t) for t in req.stream],
+                "finish_reason": req.stream.finish_reason,
+                "replica": self.rank,
+                "generation": self.engine.weight_generation,
+                "session": req.session,
+                "ttft_ms": round(req.ttft_ms, 3),
+                "queue_wait_ms": round(req.queue_wait_ms, 3)}
+
+    def drain(self) -> None:
+        self.draining = True
+        telemetry.record("serve_drain", executor="ReplicaServer",
+                         rank=self.rank)
+
+    def undrain(self) -> None:
+        self.draining = False
+        telemetry.record("serve_undrain", executor="ReplicaServer",
+                         rank=self.rank)
+
+    def healthz(self) -> dict:
+        return {"ok": self._error is None and not self.draining,
+                "draining": self.draining, "error": self._error,
+                "rank": self.rank, "outstanding": self._outstanding}
+
+    def statusz(self) -> dict:
+        return {"rank": self.rank, "draining": self.draining,
+                "outstanding": self._outstanding, "error": self._error,
+                "engine": self.engine.statusz_snapshot(),
+                "time": round(time.time(), 3)}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    """Router routes — jax-free by construction (pure HTTP relay +
+    host-side bookkeeping); mxlint JAX_FREE_ENTRIES scans from here."""
+
+    server_version = "mxnet-tpu-router/1"
+
+    def do_GET(self):  # noqa: N802
+        router: "Router" = self.server.router  # type: ignore
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if route in ("/", "/statusz"):
+            _send(self, 200, router.statusz())
+        elif route == "/healthz":
+            snap = router.healthz()
+            _send(self, 200 if snap["ok"] else 503, snap)
+        else:
+            _send(self, 404, {"error": f"no such route {route!r}"})
+
+    def do_POST(self):  # noqa: N802
+        router: "Router" = self.server.router  # type: ignore
+        route, _, query = self.path.partition("?")
+        route = route.rstrip("/")
+        if route == "/generate":
+            try:
+                body = _read_json_body(self)
+            except (ValueError, UnicodeDecodeError) as e:
+                _send(self, 400, {"error": f"bad JSON body: {e}"})
+                return
+            code, payload = router.dispatch(body)
+            _send(self, code, payload)
+        elif route in ("/admin/drain", "/admin/undrain"):
+            rank = None
+            for part in query.split("&"):
+                if part.startswith("rank="):
+                    try:
+                        rank = int(part[5:])
+                    except ValueError:
+                        pass
+            if rank is None:
+                _send(self, 400, {"error": "need ?rank=N"})
+                return
+            ok = router.set_drain(rank, route.endswith("/drain"))
+            _send(self, 200 if ok else 404,
+                  {"rank": rank, "draining": route.endswith("/drain"),
+                   "ok": ok})
+        else:
+            _send(self, 404, {"error": f"no such route {route!r}"})
+
+    def log_message(self, fmt, *args):
+        _LOG.debug("%s %s", self.address_string(), fmt % args)
+
+
+class Router:
+    """Load-balancing front door over N replica servers.
+
+    Discovery is portfile-based (``serve-port-*.json`` under
+    ``directory``) and re-runs at every health poll, so replicas added
+    by an ``--elastic`` rescale join rotation automatically and dead
+    ones fall out.  Dispatch policy: session affinity first (an id seen
+    before goes back to its replica while that replica is healthy —
+    keeping its prefix-cache pages hot), otherwise least outstanding
+    requests among healthy, undrained replicas."""
+
+    def __init__(self, directory: str, port: Optional[int] = None,
+                 host: Optional[str] = None,
+                 health_sec: Optional[float] = None):
+        self.directory = directory
+        self._host = host if host is not None \
+            else os.environ.get("MX_SERVE_HOST", "127.0.0.1")
+        self._bind_port = _env_int("MX_SERVE_ROUTER_PORT", 0) \
+            if port is None else int(port)
+        self.health_sec = _env_float("MX_SERVE_HEALTH_SEC", 2.0) \
+            if health_sec is None else float(health_sec)
+        self._lock = threading.Lock()
+        # rank -> {rank, host, port, url, healthy, draining, outstanding}
+        self._replicas: Dict[int, dict] = {}
+        self._sessions: Dict[str, int] = {}
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.port = 0
+        self.dispatched = 0
+        self.failovers = 0
+        self.refresh()
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> "Router":
+        server = ThreadingHTTPServer((self._host, self._bind_port),
+                                     _RouterHandler)
+        server.daemon_threads = True
+        server.router = self  # type: ignore[attr-defined]
+        self._server = server
+        self.port = server.server_address[1]
+        self._http_thread = threading.Thread(
+            target=server.serve_forever, daemon=True,
+            name="mx-serve-router-http")
+        self._http_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name="mx-serve-router-health")
+        self._health_thread.start()
+        _LOG.info("router serving on %s:%d over %d replica(s)",
+                  self._host, self.port, len(self._replicas))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+
+    # ---- replica set -------------------------------------------------
+    def refresh(self) -> None:
+        """Re-discover replicas from portfiles: new ranks join rotation
+        (healthy until a probe says otherwise), vanished ranks drop."""
+        found = {r["rank"]: r for r in discover_replicas(self.directory)}
+        with self._lock:
+            for rank, info in found.items():
+                cur = self._replicas.get(rank)
+                url = f"http://{info['host']}:{info['port']}"
+                if cur is None or cur["url"] != url:
+                    self._replicas[rank] = {
+                        "rank": rank, "url": url, "healthy": True,
+                        "draining": False, "outstanding": 0}
+            for rank in list(self._replicas):
+                if rank not in found:
+                    del self._replicas[rank]
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_sec):
+            self.refresh()
+            for rep in self.replicas():
+                self._probe(rep)
+
+    def _probe(self, rep: dict) -> None:
+        try:
+            with urllib.request.urlopen(rep["url"] + "/healthz",
+                                        timeout=2.0) as resp:
+                snap = json.load(resp)
+            healthy, draining = True, bool(snap.get("draining"))
+        except urllib.error.HTTPError as e:
+            # 503 = alive but draining/erroring: keep it out of rotation
+            # without forgetting it (undrain brings it straight back)
+            try:
+                snap = json.load(e)
+            except (ValueError, OSError):
+                snap = {}
+            healthy, draining = False, bool(snap.get("draining"))
+        except (OSError, ValueError):
+            healthy, draining = False, False
+        with self._lock:
+            cur = self._replicas.get(rep["rank"])
+            if cur is not None:
+                cur["healthy"] = healthy
+                cur["draining"] = draining
+
+    def replicas(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._replicas.values()]
+
+    # ---- dispatch ----------------------------------------------------
+    def _pick(self, session: Optional[str], exclude) -> Optional[dict]:
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r["healthy"] and not r["draining"]
+                    and r["rank"] not in exclude]
+            if not live:
+                return None
+            if session is not None:
+                rank = self._sessions.get(session)
+                for r in live:
+                    if r["rank"] == rank:
+                        return r
+            pick = min(live, key=lambda r: (r["outstanding"], r["rank"]))
+            if session is not None:
+                # (re)pin — a failed-over session sticks to its NEW home
+                self._sessions[session] = pick["rank"]
+            return pick
+
+    def dispatch(self, body: dict):
+        """Route one /generate body; returns (http_code, payload).
+        Connection-level failures mark the replica dead and fail the
+        request over; HTTP-level errors (4xx validation, 503 back-
+        pressure) are the replica's verdict and pass through."""
+        session = body.get("session")
+        timeout_s = float(body.get("timeout_s", 120.0))
+        raw = json.dumps(body).encode("utf-8")
+        tried: set = set()
+        while True:
+            rep = self._pick(session, tried)
+            if rep is None:
+                return 503, {"error": "no healthy replica available",
+                             "tried": sorted(tried)}
+            tried.add(rep["rank"])
+            req = urllib.request.Request(
+                rep["url"] + "/generate", data=raw,
+                headers={"Content-Type": "application/json"})
+            with self._lock:
+                cur = self._replicas.get(rep["rank"])
+                if cur is not None:
+                    cur["outstanding"] += 1
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as resp:
+                    payload = json.load(resp)
+                self.dispatched += 1
+                payload["routed_to"] = rep["rank"]
+                return 200, payload
+            except urllib.error.HTTPError as e:
+                try:
+                    payload = json.load(e)
+                except (ValueError, OSError):
+                    payload = {"error": f"replica HTTP {e.code}"}
+                payload["routed_to"] = rep["rank"]
+                return e.code, payload
+            except (urllib.error.URLError, OSError) as e:
+                # connection-level death: mark dead, fail over
+                with self._lock:
+                    cur = self._replicas.get(rep["rank"])
+                    if cur is not None:
+                        cur["healthy"] = False
+                self.failovers += 1
+                telemetry.record("serve_failover", executor="Router",
+                                 rank=rep["rank"], error=str(e)[:200])
+                _LOG.warning("replica %d unreachable (%s); failing over",
+                             rep["rank"], e)
+            finally:
+                with self._lock:
+                    cur = self._replicas.get(rep["rank"])
+                    if cur is not None:
+                        cur["outstanding"] = max(
+                            0, cur["outstanding"] - 1)
+
+    # ---- admin + introspection ---------------------------------------
+    def set_drain(self, rank: int, draining: bool) -> bool:
+        """Forward drain/undrain to a replica and mirror the flag
+        locally so rotation updates immediately (the health poll would
+        get there eventually)."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            url = rep["url"] if rep is not None else None
+        if url is None:
+            return False
+        verb = "drain" if draining else "undrain"
+        try:
+            req = urllib.request.Request(f"{url}/admin/{verb}", data=b"")
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except (urllib.error.URLError, OSError) as e:
+            _LOG.warning("drain forward to replica %d failed: %s",
+                         rank, e)
+            return False
+        with self._lock:
+            rep = self._replicas.get(rank)
+            if rep is not None:
+                rep["draining"] = draining
+        return True
+
+    def healthz(self) -> dict:
+        reps = self.replicas()
+        healthy = [r["rank"] for r in reps
+                   if r["healthy"] and not r["draining"]]
+        return {"ok": bool(healthy), "healthy": healthy,
+                "replicas": len(reps)}
+
+    def statusz(self) -> dict:
+        with self._lock:
+            sessions = len(self._sessions)
+        return {"replicas": self.replicas(), "sessions": sessions,
+                "dispatched": self.dispatched,
+                "failovers": self.failovers,
+                "health_sec": self.health_sec,
+                "time": round(time.time(), 3)}

@@ -15,10 +15,11 @@ overriding :meth:`ContinuousBatchingScheduler.pop_ready`.
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import List, Optional
 
 import numpy as np
@@ -26,7 +27,7 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["Request", "TokenStream", "ContinuousBatchingScheduler",
-           "queue_bound"]
+           "queue_bound", "PrefixCache", "prefix_key"]
 
 _ids = itertools.count()
 
@@ -73,16 +74,48 @@ class Request:
     ``tokens`` is the prompt — the source sentence for seq2seq models
     (prefill = encode), the prompt prefix for decoder-only models
     (prefill = fill the cache/buffer).  Generation starts from
-    ``bos_id`` and stops at ``eos_id`` or after ``max_new_tokens``."""
+    ``bos_id`` and stops at ``eos_id`` or after ``max_new_tokens``.
+
+    Sampling (docs/SERVING.md §Sampling): ``temperature`` 0.0 (the
+    default) is greedy — BITWISE identical to the engine's original
+    greedy path; > 0 samples from the temperature-scaled distribution,
+    optionally truncated by ``top_k`` (0 = off) and nucleus ``top_p``
+    (1.0 = off).  ``seed`` pins the request's private RNG stream: the
+    same request with the same seed reproduces the same tokens across
+    engines, restarts and slot assignments (the per-request key is
+    carried as per-slot device state).
+
+    ``prefix`` (optional int32 tokens) is a decoder-side forced prefix:
+    the engine teacher-forces it into the slot's KV pages before free
+    decode starts, and — with the prefix cache on — shares those pages
+    across requests with an identical (source, prefix) instead of
+    recomputing them.  ``session`` is an opaque affinity id the router
+    uses to pin a conversation to one replica."""
 
     def __init__(self, tokens, max_new_tokens: int, bos_id: int,
-                 eos_id: int, request_id: Optional[str] = None):
+                 eos_id: int, request_id: Optional[str] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None,
+                 prefix=None, session: Optional[str] = None):
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         if self.max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
         self.bos_id = int(bos_id)
         self.eos_id = int(eos_id)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if self.temperature < 0.0:
+            raise MXNetError("temperature must be >= 0 (0 = greedy)")
+        if self.top_k < 0:
+            raise MXNetError("top_k must be >= 0 (0 = off)")
+        if not (0.0 < self.top_p <= 1.0):
+            raise MXNetError("top_p must be in (0, 1] (1.0 = off)")
+        self.seed = None if seed is None else int(seed)
+        self.prefix = (np.zeros((0,), np.int32) if prefix is None
+                       else np.asarray(prefix, np.int32).reshape(-1))
+        self.session = session
         self.id = request_id if request_id is not None \
             else f"req{next(_ids)}"
         self.stream = TokenStream()
@@ -177,3 +210,104 @@ class ContinuousBatchingScheduler:
             budget -= 1  # reserve the first page; later pages grow on
             #              demand per dispatch burst (engine._ensure_pages)
         return out
+
+
+# ---------------------------------------------------------------------------
+# prefix cache index (docs/SERVING.md §Prefix cache)
+# ---------------------------------------------------------------------------
+def prefix_key(*parts) -> str:
+    """Stable token-hash key for a prefix-cache entry.  Parts are ints,
+    strings or int arrays (token vectors); the digest is restart-stable
+    (content only, no object ids)."""
+    h = hashlib.sha1()
+    for p in parts:
+        if isinstance(p, np.ndarray):
+            h.update(b"a" + np.ascontiguousarray(p, np.int64).tobytes())
+        else:
+            h.update(b"s" + repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+class PrefixCache:
+    """LRU token-hash index over reusable prefill work (host-side
+    bookkeeping only — payloads are opaque to this class).
+
+    Two entry kinds share the index: ``"pages"`` entries point at KV
+    pages in the :class:`~.paged_cache.PagedKVCache` that hold a
+    teacher-forced decoder prefix (the engine adopts/copies them on a
+    hit instead of re-ingesting), and ``"prefill"`` entries hold device
+    copies of the prefill executable's per-slot output rows (e.g. the
+    encoder memory for a seq2seq source) so a repeated source skips the
+    prefill dispatch entirely.
+
+    Every entry is stamped with the engine's weight generation at
+    insert: a hot-swap bumps the generation, and ``invalidate_stale``
+    drops every entry from an older generation at the flip — a post-swap
+    request can never fork KV pages computed under old weights
+    (docs/SERVING.md §Weight hot-swap).
+
+    Eviction: ``put`` bounds the index at ``max_entries`` (LRU), and the
+    engine calls ``pop_lru("pages")`` under pool pressure BEFORE falling
+    back to recompute-preemption of a live request.  Dropped entries are
+    RETURNED to the caller, which owns freeing any allocator pages they
+    reference."""
+
+    def __init__(self, max_entries: int = 64):
+        self.max_entries = max(1, int(max_entries))
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def get(self, key: str, generation: int) -> Optional[dict]:
+        """Look up ``key``; counts a hit only for a same-generation
+        entry.  A stale-generation entry is treated as (and counted as)
+        a miss — the caller re-ingests and ``put`` replaces it."""
+        e = self._entries.get(key)
+        if e is not None and e["generation"] == generation:
+            self._entries.move_to_end(key)
+            e["uses"] += 1
+            self.hits += 1
+            return e
+        self.misses += 1
+        return None
+
+    def put(self, key: str, kind: str, generation: int,
+            payload: dict) -> List[dict]:
+        """Insert/replace an entry; returns the entries displaced by the
+        LRU bound (plus any same-key predecessor) for the caller to
+        release."""
+        dropped = []
+        old = self._entries.pop(key, None)
+        if old is not None:
+            dropped.append(old)
+        self._entries[key] = {"key": key, "kind": kind,
+                              "generation": int(generation),
+                              "payload": payload, "uses": 0}
+        while len(self._entries) > self.max_entries:
+            _, e = self._entries.popitem(last=False)
+            dropped.append(e)
+        return dropped
+
+    def pop_lru(self, kind: Optional[str] = None) -> Optional[dict]:
+        """Drop and return the least-recently-used entry (optionally of
+        one kind) — the engine's evict-before-preempt lever."""
+        for key, e in self._entries.items():
+            if kind is None or e["kind"] == kind:
+                return self._entries.pop(key)
+        return None
+
+    def invalidate_stale(self, generation: int) -> List[dict]:
+        """Drop every entry older than ``generation`` (the weight-swap
+        flip).  Returns the dropped entries for page release."""
+        stale = [k for k, e in self._entries.items()
+                 if e["generation"] != generation]
+        return [self._entries.pop(k) for k in stale]
+
+    def clear(self) -> List[dict]:
+        dropped = list(self._entries.values())
+        self._entries.clear()
+        return dropped

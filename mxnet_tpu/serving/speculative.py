@@ -1,0 +1,77 @@
+"""Draft proposers for speculative decoding (docs/SERVING.md
+§Speculative decoding).
+
+Speculative decoding splits a decode step in two: a cheap host-side
+*draft* proposes up to K next tokens, and the engine's ("verify", K)
+executable teacher-forces all K through the target model in ONE ragged
+paged decode dispatch — per-slot accepted-token counts are device
+values, exactly the per-slot length masking the ragged paged-attention
+design (PAPERS.md 2604.15464) already handles.  Standard
+accept/resample (Leviathan et al.) keeps the OUTPUT DISTRIBUTION
+identical to non-speculative sampling, and under greedy decode
+(temperature 0) acceptance is argmax-equality so the emitted stream is
+BITWISE identical to the plain decode path (tests/test_serving_sampling
+asserts it at K in {1, 4}).
+
+A draft is anything with ``propose(request, generated, k)`` returning
+up to ``k`` int token ids — the engine never traces it, so drafts can
+be arbitrary host code: an n-gram table, a distilled model running
+eagerly, a grammar.  The default :class:`NGramDraft` is prompt-lookup
+decoding (He et al., "LLMA"): match the tail of what has been generated
+against the request's own prompt/prefix/history and propose the
+continuation — free to compute, surprisingly effective on the copy-like
+spans real serving traffic is full of (quotes, code edits, retrieval).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["DraftProposer", "NGramDraft"]
+
+
+class DraftProposer:
+    """Host-side draft interface for the engine's speculative mode."""
+
+    def propose(self, request, generated: Sequence[int],
+                k: int) -> List[int]:
+        """Up to ``k`` proposed next tokens for ``request`` given the
+        tokens ``generated`` so far (free-decode tokens only — the
+        forced prefix is on ``request.prefix``).  Fewer (or zero)
+        proposals are always legal: the verify step treats the proposal
+        count as a per-slot ragged length."""
+        raise NotImplementedError
+
+
+class NGramDraft(DraftProposer):
+    """Prompt-lookup drafting: propose the continuation of the most
+    recent place the current ``n``-gram tail occurred earlier in the
+    request's own token history (prompt + forced prefix + generated).
+
+    ``include_prompt`` folds ``request.tokens`` into the lookup pool —
+    right for decoder-only prompts and for copy/transform tasks where
+    source and target share a vocabulary; turn it off for seq2seq
+    models whose source ids live in a different vocabulary."""
+
+    def __init__(self, n: int = 2, include_prompt: bool = True):
+        if n < 1:
+            raise ValueError("NGramDraft needs n >= 1")
+        self.n = int(n)
+        self.include_prompt = bool(include_prompt)
+
+    def propose(self, request, generated: Sequence[int],
+                k: int) -> List[int]:
+        pool: List[int] = []
+        if self.include_prompt:
+            pool.extend(int(t) for t in request.tokens)
+        pool.extend(int(t) for t in getattr(request, "prefix", ()))
+        pool.extend(int(t) for t in generated)
+        for n in range(min(self.n, len(pool)), 0, -1):
+            tail = pool[-n:]
+            # most recent earlier occurrence wins (locality: recent
+            # context repeats more than distant context)
+            for start in range(len(pool) - n - 1, -1, -1):
+                if pool[start:start + n] == tail:
+                    nxt = pool[start + n:start + n + k]
+                    if nxt:
+                        return nxt
+        return []

@@ -176,7 +176,17 @@ class _State:
                       # zero-downtime hot-swap counters: which weight
                       # generation is serving and how many swaps applied
                       # (docs/SERVING.md §Weight hot-swap)
-                      "weight_generation": 0, "weight_swaps": 0}
+                      "weight_generation": 0, "weight_swaps": 0,
+                      # prefix-cache counters (docs/SERVING.md §Prefix
+                      # cache): hits/misses across both entry kinds +
+                      # how many prefix tokens skipped recompute
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_tokens_reused": 0,
+                      # speculative decoding (§Speculative decoding):
+                      # lifetime draft tokens proposed/accepted — the
+                      # acceptance rate IS the speedup lever
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
         # newest in-flight dispatch-window depth any executor reported
         # (record_step's inflight_depth field) — a /healthz input
         self.inflight_depth = 0
@@ -695,6 +705,44 @@ def record_weight_swap(generation: int, staged_bytes: int = 0,
            flip_ms=round(float(flip_ms), 3), **fields)
 
 
+def record_serve_prefix(kind: str, hit: bool, tokens: int = 0,
+                        **fields) -> None:
+    """One prefix-cache lookup (mxnet_tpu.serving.engine — docs/
+    SERVING.md §Prefix cache).  ``kind`` is the entry family ("pages"
+    for forked KV pages, "prefill" for reused prefill rows); a hit adds
+    ``tokens`` to the reused-token counter (prefill/ingest work skipped).
+    Aggregate-only counters + one flight-ring event per lookup — cheap
+    at serving cadence (one lookup per admission, never per step)."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        sv = _state.serve
+        sv["prefix_hits" if hit else "prefix_misses"] += 1
+        if hit:
+            sv["prefix_tokens_reused"] += int(tokens)
+    record("serve_prefix", entry_kind=str(kind), hit=bool(hit),
+           tokens=int(tokens), **fields)
+
+
+def record_spec_verify(proposed: int, accepted: int, **fields) -> None:
+    """One speculative verify boundary (mxnet_tpu.serving.engine —
+    docs/SERVING.md §Speculative decoding): how many draft tokens the
+    boundary proposed across slots and how many the target accepted.
+    The lifetime acceptance rate (accepted/proposed) surfaces in
+    ``summary()['serving']['spec']`` and ``mx_serve_spec_accept_rate`` —
+    it is the whole speedup story: every accepted token is a decode
+    step the engine never dispatched."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        sv = _state.serve
+        sv["spec_rounds"] += 1
+        sv["spec_proposed"] += int(proposed)
+        sv["spec_accepted"] += int(accepted)
+    record("spec_verify", proposed=int(proposed), accepted=int(accepted),
+           **fields)
+
+
 def _percentile(sorted_vals, q: float) -> float:
     """Nearest-rank percentile over an ascending list (stdlib-only —
     telemetry must not import numpy)."""
@@ -876,6 +924,23 @@ def _serving_rollup() -> dict:
         "precision": sv.get("precision", "fp32"),
         "weight_generation": sv.get("weight_generation", 0),
         "weight_swaps": sv.get("weight_swaps", 0),
+        "prefix_cache": {
+            "hits": sv.get("prefix_hits", 0),
+            "misses": sv.get("prefix_misses", 0),
+            "tokens_reused": sv.get("prefix_tokens_reused", 0),
+            "hit_rate": round(
+                sv.get("prefix_hits", 0)
+                / max(1, sv.get("prefix_hits", 0)
+                      + sv.get("prefix_misses", 0)), 4),
+        },
+        "spec": {
+            "rounds": sv.get("spec_rounds", 0),
+            "proposed": sv.get("spec_proposed", 0),
+            "accepted": sv.get("spec_accepted", 0),
+            "accept_rate": round(
+                sv.get("spec_accepted", 0)
+                / max(1, sv.get("spec_proposed", 0)), 4),
+        },
     }
 
 
@@ -1298,6 +1363,23 @@ def render_prometheus(mode: str = "live") -> str:
         lines.append(
             f'mx_serve_precision_info{{{rank_lbl},'
             f'precision="{_prom_escape(sv.get("precision", "fp32"))}"}} 1')
+        pc = sv.get("prefix_cache", {})
+        if pc.get("hits") or pc.get("misses"):
+            gauge("mx_serve_prefix_hits_total", pc["hits"], kind="counter")
+            gauge("mx_serve_prefix_misses_total", pc["misses"],
+                  kind="counter")
+            gauge("mx_serve_prefix_tokens_reused_total",
+                  pc["tokens_reused"], kind="counter")
+            gauge("mx_serve_prefix_hit_rate", pc["hit_rate"])
+        sp = sv.get("spec", {})
+        if sp.get("rounds"):
+            gauge("mx_serve_spec_rounds_total", sp["rounds"],
+                  kind="counter")
+            gauge("mx_serve_spec_proposed_total", sp["proposed"],
+                  kind="counter")
+            gauge("mx_serve_spec_accepted_total", sp["accepted"],
+                  kind="counter")
+            gauge("mx_serve_spec_accept_rate", sp["accept_rate"])
     per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
     per_key("mx_span_ms_total", s["spans"], "total_ms", "span",
             kind="counter")

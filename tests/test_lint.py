@@ -896,6 +896,92 @@ def test_serving_dispatch_entry_registered_and_rename_fails_loudly(tmp_path):
     assert findings == []
 
 
+def test_serving_front_door_entries_registered(tmp_path):
+    """PR 17's jitted bodies (sampled decode, speculative verify, prefix
+    ingest) and the spec dispatch are in the REAL HOT_PATH_ENTRIES, and
+    the replica/router HTTP handlers are in the REAL JAX_FREE_ENTRIES."""
+    real = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/serving/engine.py"]
+    for entry in ("ServingEngine._dispatch_spec",
+                  "ServingEngine._decode_body",
+                  "ServingEngine._verify_body",
+                  "ServingEngine._ingest_body"):
+        assert entry in real, entry
+    handlers = mxlint.JAX_FREE_ENTRIES["mxnet_tpu/serving/router.py"]
+    for entry in ("_ReplicaHandler.do_GET", "_ReplicaHandler.do_POST",
+                  "_RouterHandler.do_GET", "_RouterHandler.do_POST"):
+        assert entry in handlers, entry
+
+
+def test_verify_body_sync_flagged_and_clean_shape_passes(tmp_path):
+    """A host readback inside the speculative verify trace body (or
+    anything it reaches) is flagged; the real body's shape — pure
+    NDArray math chained through helpers — is clean."""
+    entries = {"mxnet_tpu/fixture.py": ("ServingEngine._verify_body",)}
+    findings, _ = lint_src(tmp_path, """
+        class ServingEngine:
+            def _verify_body(self, nds):
+                logits = self._chain(nds)
+                return self._accept(logits)
+
+            def _accept(self, logits):
+                return logits[0].asnumpy()   # sync inside the trace body
+
+            def _chain(self, nds):
+                return nds
+        """, hot_entries=entries)
+    assert rules_of(findings) == ["hot-sync"]
+    assert findings[0].context == "ServingEngine._accept"
+
+    findings, _ = lint_src(tmp_path, """
+        class ServingEngine:
+            def _verify_body(self, nds):
+                state = dict(zip(self._names, nds))
+                logits, extra, pools = self._chain_logits(state)
+                counts = self._accept(logits)
+                return (counts,) + tuple(state.values())
+
+            def _chain_logits(self, state):
+                return state, state, state
+
+            def _accept(self, logits):
+                return logits
+        """, hot_entries=entries)
+    assert findings == []
+
+
+def test_router_handler_jax_use_flagged(tmp_path):
+    """A jax import (or device readback) reachable from the replica
+    /generate handler is flagged — handlers must only submit and poll
+    host-side stream flags; the engine-driver thread owns the device."""
+    jax_free = {"mxnet_tpu/fixture.py": ("_ReplicaHandler.do_POST",)}
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class _ReplicaHandler:
+            def do_POST(self):
+                import jax
+                jax.block_until_ready(self.server.replica.engine._state)
+        """, jax_free=jax_free)
+    assert "jax-in-handler" in rules_of(findings)
+
+    findings, _ = _lint_jaxfree(tmp_path, """
+        import json
+        import time
+
+        class _ReplicaHandler:
+            def do_POST(self):
+                req = self.server.replica.submit(self._body())
+                while not req.stream.finished:
+                    time.sleep(0.002)
+                self._send(200, json.dumps(list(req.stream)))
+
+            def _body(self):
+                return {}
+
+            def _send(self, code, payload):
+                pass
+        """, jax_free=jax_free)
+    assert findings == []
+
+
 def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
     (tmp_path / "mxnet_tpu").mkdir(parents=True)
     (tmp_path / "mxnet_tpu" / "broken.py").write_text("def f(:\n")

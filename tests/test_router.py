@@ -1,0 +1,313 @@
+"""Multi-replica serving router (ISSUE 17; docs/SERVING.md §Front
+door).
+
+Covers: portfile discovery (torn files skipped), session affinity,
+least-outstanding dispatch, replica-death failover (connection error →
+mark dead, retry elsewhere, session re-pins), graceful drain/undrain
+through the router, HTTP error passthrough, and one end-to-end
+ReplicaServer round-trip over a REAL engine (sampling defaults applied
+at the HTTP layer, /statusz, backpressure 503).
+
+The fleet tests run against fake no-jax workers — plain
+``http.server`` loops that echo tokens and record what they saw — so
+failover/affinity logic is exercised without ever compiling a model.
+"""
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.serving import (Router, discover_replicas,
+                               serve_portfile_path)
+
+PAD, BOS, EOS = 0, 1, 2
+
+
+# ---------------------------------------------------------------------------
+# fake no-jax worker
+# ---------------------------------------------------------------------------
+class _FakeWorker:
+    """A replica-shaped HTTP server with no engine behind it: /generate
+    echoes ``[rank, *prompt]``, /healthz follows the draining flag, and
+    every request body lands in ``self.seen``."""
+
+    def __init__(self, directory, rank):
+        self.rank = rank
+        self.seen = []
+        self.draining = False
+        worker = self
+
+        class H(BaseHTTPRequestHandler):
+            def _send(self, code, payload):
+                raw = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/healthz"):
+                    ok = not worker.draining
+                    self._send(200 if ok else 503,
+                               {"ok": ok, "draining": worker.draining,
+                                "rank": worker.rank})
+                else:
+                    self._send(200, {"rank": worker.rank})
+
+            def do_POST(self):  # noqa: N802
+                if self.path.startswith("/admin/"):
+                    worker.draining = self.path.endswith("/drain")
+                    self._send(200, {"draining": worker.draining,
+                                     "rank": worker.rank})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                worker.seen.append(body)
+                if body.get("boom"):
+                    self._send(400, {"error": "synthetic validation",
+                                     "rank": worker.rank})
+                    return
+                self._send(200, {
+                    "request_id": body.get("request_id", "r"),
+                    "tokens": [worker.rank] + list(body["prompt"]),
+                    "finish_reason": "length",
+                    "replica": worker.rank,
+                    "session": body.get("session")})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self.portfile = serve_portfile_path(directory, rank)
+        tmp = self.portfile + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rank": rank, "host": "127.0.0.1",
+                       "port": self.port, "pid": os.getpid(),
+                       "time": 0.0}, f)
+        os.replace(tmp, self.portfile)
+
+    def kill(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    d = str(tmp_path)
+    workers = [_FakeWorker(d, r) for r in range(2)]
+    # long health period: tests drive refresh()/dispatch() directly so
+    # probe timing never races the assertions
+    router = Router(d, port=0, health_sec=60.0)
+    yield d, workers, router
+    router.stop()
+    for w in workers:
+        try:
+            w.kill()
+        except Exception:
+            pass
+
+
+def _post(port, body, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        return json.load(r)
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+def test_portfile_discovery_skips_torn_files(tmp_path):
+    d = str(tmp_path)
+    _FakeWorker(d, 0)
+    _FakeWorker(d, 3)
+    with open(os.path.join(d, "serve-port-9.json"), "w") as f:
+        f.write('{"rank": 9, "po')  # torn mid-write
+    with open(os.path.join(d, "metrics-port-0.json"), "w") as f:
+        f.write("{}")  # wrong family, ignored
+    got = discover_replicas(d)
+    assert sorted(r["rank"] for r in got) == [0, 3]
+    assert all(r["host"] == "127.0.0.1" and r["port"] > 0 for r in got)
+
+
+# ---------------------------------------------------------------------------
+# affinity + balancing
+# ---------------------------------------------------------------------------
+def test_session_affinity_pins_conversation(fleet):
+    """ACCEPTANCE: every request of a session lands on ONE replica (its
+    prefix-cache pages stay hot there); session-free requests spread by
+    least-outstanding."""
+    _, workers, router = fleet
+    router.start()
+    outs = [_post(router.port, {"prompt": [5, 6], "session": "conv-a"})
+            for _ in range(4)]
+    homes = {o["routed_to"] for o in outs}
+    assert len(homes) == 1
+    home = homes.pop()
+    assert all(o["replica"] == home for o in outs)
+    assert len(workers[home].seen) == 4
+    # a different session may pin elsewhere, but is itself sticky
+    outs_b = [_post(router.port, {"prompt": [7], "session": "conv-b"})
+              for _ in range(3)]
+    assert len({o["routed_to"] for o in outs_b}) == 1
+
+
+def test_sessionless_requests_balance_by_outstanding(fleet):
+    _, workers, router = fleet
+    # drive dispatch() directly and fake an in-flight imbalance
+    with router._lock:
+        router._replicas[0]["outstanding"] = 5
+    code, payload = router.dispatch({"prompt": [3]})
+    assert code == 200 and payload["routed_to"] == 1
+    with router._lock:
+        router._replicas[1]["outstanding"] = 9
+    code, payload = router.dispatch({"prompt": [3]})
+    assert code == 200 and payload["routed_to"] == 0
+
+
+# ---------------------------------------------------------------------------
+# failover + drain
+# ---------------------------------------------------------------------------
+def test_replica_death_fails_over_and_repins_session(fleet):
+    """ACCEPTANCE: a replica dropping mid-conversation is marked dead on
+    the connection error; the request retries on the survivor and the
+    session re-pins there — the client only sees tokens from its new
+    home."""
+    d, workers, router = fleet
+    router.start()
+    first = _post(router.port, {"prompt": [4], "session": "s"})
+    home = first["routed_to"]
+    workers[home].kill()
+    out = _post(router.port, {"prompt": [4, 4], "session": "s"})
+    other = 1 - home
+    assert out["routed_to"] == other
+    assert out["tokens"] == [other, 4, 4]
+    assert router.failovers == 1
+    snap = router.statusz()
+    dead = [r for r in snap["replicas"] if r["rank"] == home][0]
+    assert dead["healthy"] is False
+    # the re-pinned session keeps landing on the survivor
+    again = _post(router.port, {"prompt": [4], "session": "s"})
+    assert again["routed_to"] == other
+    # both replicas down: an honest 503, not a hang
+    workers[other].kill()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(router.port, {"prompt": [4]})
+    assert ei.value.code == 503
+    assert "no healthy replica" in json.load(ei.value)["error"]
+
+
+def test_vanished_portfile_drops_replica_on_refresh(fleet):
+    _, workers, router = fleet
+    assert sorted(r["rank"] for r in router.replicas()) == [0, 1]
+    workers[1].kill()
+    os.unlink(workers[1].portfile)
+    router.refresh()
+    assert [r["rank"] for r in router.replicas()] == [0]
+    code, payload = router.dispatch({"prompt": [8]})
+    assert code == 200 and payload["routed_to"] == 0
+
+
+def test_drain_undrain_through_router(fleet):
+    """Graceful drain: the drained replica 503s /healthz and leaves
+    rotation (health probe respects the flag); undrain brings it
+    straight back — the rescale/hot-swap maintenance loop."""
+    _, workers, router = fleet
+    router.start()
+    assert router.set_drain(0, True)
+    assert workers[0].draining is True
+    router._probe({"rank": 0, "url": f"http://127.0.0.1:{workers[0].port}"})
+    for _ in range(4):
+        out = _post(router.port, {"prompt": [2]})
+        assert out["routed_to"] == 1
+    assert router.set_drain(0, False)
+    router._probe({"rank": 0, "url": f"http://127.0.0.1:{workers[0].port}"})
+    live = {r["rank"]: r for r in router.replicas()}
+    assert live[0]["healthy"] and not live[0]["draining"]
+    assert not router.set_drain(7, True), "unknown rank refused"
+
+
+def test_http_errors_pass_through_without_failover(fleet):
+    """A replica's 4xx verdict is the CLIENT's problem: no failover, no
+    dead-marking, the code and body relay verbatim."""
+    _, workers, router = fleet
+    code, payload = router.dispatch({"prompt": [1], "boom": True})
+    assert code == 400
+    assert payload["error"] == "synthetic validation"
+    assert router.failovers == 0
+    assert all(r["healthy"] for r in router.replicas())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over a real engine
+# ---------------------------------------------------------------------------
+def test_replica_server_end_to_end(tmp_path):
+    """One ReplicaServer over a real (untrained, tiny) engine: HTTP
+    /generate matches an in-process serve() bitwise, MX_SERVE_TEMPERATURE
+    fleet defaults apply at the HTTP layer only, /statusz surfaces the
+    engine snapshot, and a full queue answers 503."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import (ReplicaServer, Request, ServingEngine,
+                                   TransformerAdapter)
+
+    mx.random.seed(0)
+    net = Transformer(16, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=48, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+
+    def eng():
+        return ServingEngine(TransformerAdapter(net, src_max_len=6),
+                             slots=2, page_size=4, max_len=12,
+                             stream_every=4, sampling=True)
+
+    prompt = [5, 6, 7]
+    want = eng().serve([Request(prompt, max_new_tokens=6, bos_id=BOS,
+                                eos_id=EOS, request_id="w")])["w"]
+    rep = ReplicaServer(eng(), bos_id=BOS, eos_id=EOS, port=0,
+                        directory=str(tmp_path)).start()
+    try:
+        out = _post(rep.port, {"prompt": prompt, "max_new_tokens": 6})
+        assert out["tokens"] == [int(t) for t in want]
+        assert out["finish_reason"] == "length"
+        assert out["generation"] == 0 and out["ttft_ms"] > 0
+        # the portfile advertises this exact server
+        got = discover_replicas(str(tmp_path))
+        assert [(r["rank"], r["port"]) for r in got] == [(rep.rank,
+                                                          rep.port)]
+        # fleet-wide sampling default applied at the HTTP layer: same
+        # request decodes DIFFERENTLY (and the body never said so)
+        os.environ["MX_SERVE_TEMPERATURE"] = "0.9"
+        try:
+            hot = _post(rep.port, {"prompt": prompt, "max_new_tokens": 6,
+                                   "seed": 3})
+            assert hot["tokens"] != out["tokens"]
+        finally:
+            del os.environ["MX_SERVE_TEMPERATURE"]
+        snap = _post_get(rep.port, "/statusz")
+        assert snap["rank"] == rep.rank
+        assert snap["engine"]["slots"] == 2
+        assert snap["engine"]["sampling"] is True
+    finally:
+        rep.stop()
+    assert not os.path.exists(serve_portfile_path(str(tmp_path),
+                                                  rep.rank))
+
+
+def _post_get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30.0) as r:
+        return json.load(r)

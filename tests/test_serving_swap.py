@@ -241,6 +241,79 @@ def test_swap_rejected_on_fingerprint_mismatch(tele, tmp_path):
     assert telemetry.summary()["serving"]["weight_generation"] == 0
 
 
+def test_swap_invalidates_prefix_cache(tele, tmp_path):
+    """ISSUE 17 satellite: prefix-cache entries are generation-stamped
+    and die at the weight flip — a post-swap request with the SAME
+    (source, prefix) MISSES, re-ingests under the new weights, and
+    decodes bitwise what a fresh engine on the new checkpoint decodes.
+    It can never fork KV pages teacher-forced under the old weights."""
+    from mxnet_tpu.parallel import DataParallelStep, local_mesh
+
+    net_a, net_b = _tiny_model(0), _tiny_model(7)
+    # briefly train net_b (reverse task) — untrained nets PARROT a
+    # forced prefix identically regardless of weights, which would mask
+    # a failed invalidation; a few adam steps make the continuation
+    # weight-sensitive
+    rng = np.random.RandomState(2)
+    L = 6
+    src_t = np.zeros((8, L + 1), np.int32)
+    tgt_in = np.zeros((8, L + 2), np.int32)
+    tgt_out = np.zeros((8, L + 2), np.int32)
+    for b in range(8):
+        toks = rng.randint(3, 16, L)
+        src_t[b, :L] = toks
+        rev = toks[::-1]
+        tgt_in[b, 0] = BOS
+        tgt_in[b, 1:L + 1] = rev
+        tgt_out[b, :L] = rev
+        tgt_out[b, L] = EOS
+    step = DataParallelStep(
+        net_b, lambda lo, la: label_smoothed_ce(lo, la, smoothing=0.0),
+        mesh=local_mesh(devices=[mx.current_context().jax_device]),
+        optimizer="adam", optimizer_params={"learning_rate": 5e-3})
+    for _ in range(16):
+        step.step((nd.array(src_t, dtype="int32"),
+                   nd.array(tgt_in, dtype="int32")),
+                  nd.array(tgt_out.astype(np.float32)))
+    step.sync_to_block()
+    ckdir = _gathered_ckpt(net_b, str(tmp_path / "ck"))
+    src = np.array([3, 4, 5], np.int32)
+    prefix = np.array([6, 7, 8, 9, 10], np.int32)
+
+    def mk(rid):
+        return Request(src, max_new_tokens=5, bos_id=BOS, eos_id=-1,
+                       request_id=rid, prefix=prefix)
+
+    eng = _engine(net_a, prefix_cache=True)
+    eng.serve([mk("r0")])  # registers the gen-0 pages + prefill entries
+    eng.serve([mk("r1")])  # and proves they hit pre-swap
+    assert eng._prefix.hits == 2 and len(eng._prefix) == 2
+    held = eng._cache.num_pages - 1 - eng._cache.pages_free
+    assert held > 0, "the registered entry must hold pages"
+
+    assert eng.swap_weights(ckdir) == 1
+    # the flip dropped EVERY stale-generation entry and released its
+    # pages back to the pool
+    assert len(eng._prefix) == 0
+    assert eng._cache.pages_free == eng._cache.num_pages - 1
+
+    out = eng.serve([mk("r2")])["r2"]
+    assert eng._prefix.hits == 2, "post-swap request must MISS, not fork"
+    ref = _engine(net_b, prefix_cache=True).serve(
+        [mk("r3")])["r3"]
+    np.testing.assert_array_equal(out, ref)
+    old = _engine(_tiny_model(0), prefix_cache=True).serve(
+        [mk("r4")])["r4"]
+    assert not np.array_equal(out, old), \
+        "old-weight KV would have produced these tokens — invalidation " \
+        "did nothing"
+    telemetry.flush()
+    events = [json.loads(line)
+              for line in open(telemetry.event_path(str(tmp_path), 0))]
+    inval = [e for e in events if e["kind"] == "serve_prefix_invalidate"]
+    assert len(inval) == 1 and inval[0]["dropped"] == 2
+
+
 def test_swap_rejects_missing_or_torn_checkpoint(tmp_path):
     eng = _engine(_tiny_model(0))
     os.makedirs(str(tmp_path / "empty"), exist_ok=True)

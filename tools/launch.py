@@ -530,6 +530,7 @@ class _HeartbeatMonitor:
             try:
                 for name in os.listdir(self.dir):
                     if (name.startswith("metrics-port-")
+                            or name.startswith("serve-port-")
                             or name.startswith("statusz-")) and \
                             name.endswith(".json"):
                         try:
@@ -1087,6 +1088,12 @@ def main(argv=None) -> int:
                          "MX_TELEMETRY_DIR and re-served as one "
                          "exposition with per-rank up/staleness gauges "
                          "(docs/OBSERVABILITY.md §Live metrics)")
+    ap.add_argument("--serve-port", type=int, default=None, metavar="P",
+                    help="export MX_SERVE_PORT=P to workers (0 = "
+                         "ephemeral): serving replicas bind P+rank and "
+                         "advertise serve-port-<R>.json portfiles under "
+                         "MX_TELEMETRY_DIR for router discovery "
+                         "(docs/SERVING.md §Front door)")
     ap.add_argument("--regrow-after", type=float, default=0.0, metavar="S",
                     help="elastic: after S seconds of healthy running "
                          "below the -n target, preempt the gang (final "
@@ -1112,6 +1119,8 @@ def main(argv=None) -> int:
         ap.error("--max-restarts must be >= 0")
     if args.metrics_port is not None and args.metrics_port < 0:
         ap.error("--metrics-port must be >= 0 (0 = ephemeral)")
+    if args.serve_port is not None and args.serve_port < 0:
+        ap.error("--serve-port must be >= 0 (0 = ephemeral)")
     if args.min_workers < 1 or args.min_workers > args.num_workers:
         ap.error("--min-workers must be in [1, num-workers]")
     if args.initial_workers is not None and not (
@@ -1120,7 +1129,13 @@ def main(argv=None) -> int:
     if (args.initial_workers is not None or args.regrow_after > 0) \
             and not args.elastic:
         ap.error("--initial-workers/--regrow-after require --elastic")
-    return launch_local(args.num_workers, command, force_cpu=args.force_cpu,
+    env_extra = None
+    if args.serve_port is not None:
+        # workers read MX_SERVE_PORT at ReplicaServer construction;
+        # N binds N+rank, 0 = ephemeral + portfile advertisement
+        env_extra = {"MX_SERVE_PORT": str(args.serve_port)}
+    return launch_local(args.num_workers, command, env_extra=env_extra,
+                        force_cpu=args.force_cpu,
                         max_restarts=args.max_restarts,
                         term_timeout=args.term_timeout,
                         backoff=args.restart_backoff,

@@ -116,8 +116,15 @@ HOT_PATH_ENTRIES = {
     "mxnet_tpu/kvstore.py": ("KVStore.push_bucketed",),
     # serving engine: the per-step decode dispatch body — chains device
     # state through the compiled step and admits the lazy token handle;
-    # a host sync here would serialize the whole serving pipeline
-    "mxnet_tpu/serving/engine.py": ("ServingEngine._dispatch_step",),
+    # a host sync here would serialize the whole serving pipeline.  The
+    # front-door additions ride the same contract: the speculative
+    # verify dispatch (_dispatch_spec) and the jitted trace bodies
+    # (sampled decode, K-token verify, prefix ingest) are per-step code
+    # — a readback inside any of them stalls every in-flight request
+    "mxnet_tpu/serving/engine.py": (
+        "ServingEngine._dispatch_step", "ServingEngine._dispatch_spec",
+        "ServingEngine._decode_body", "ServingEngine._verify_body",
+        "ServingEngine._ingest_body"),
 }
 
 # HTTP handler threads that must NEVER touch jax (repo-relative path ->
@@ -128,6 +135,14 @@ HOT_PATH_ENTRIES = {
 # checks PLUS a lexical jax import/alias-use scan (jax-in-handler).
 JAX_FREE_ENTRIES = {
     "mxnet_tpu/metrics_server.py": ("_Handler.do_GET",),
+    # serving front door: replica + router HTTP handlers only build
+    # Request objects, poll host-side stream flags and relay JSON — the
+    # engine-driver thread owns the device.  A jax import here can
+    # deadlock against runtime init; a readback stalls decode from an
+    # HTTP request
+    "mxnet_tpu/serving/router.py": (
+        "_ReplicaHandler.do_GET", "_ReplicaHandler.do_POST",
+        "_RouterHandler.do_GET", "_RouterHandler.do_POST"),
 }
 
 # the shard_map_compat shim's home — the ONLY file allowed to touch
