@@ -365,6 +365,22 @@ def main():
             result["router_throughput"] = rt
             print(json.dumps(result), flush=True)
 
+    # rqtrace_overhead: router tokens/sec with fleet-wide request
+    # tracing ON at sample=1.0 vs MX_RQTRACE=0, telemetry enabled in
+    # BOTH modes so the delta isolates the tracing layer alone — the
+    # "trace every request and leave it on" claim (docs/OBSERVABILITY.md
+    # §Request tracing).  Acceptance <2% (value >= 0.98).
+    if (os.environ.get("BENCH_MODEL") is None
+            and os.environ.get("BENCH_RQTRACE", "1") != "0"
+            and "error" not in result):
+        rq = _run_child("cpu", float(os.environ.get(
+            "BENCH_RQTRACE_TIMEOUT", 420)), history,
+            extra_env={"BENCH_MODEL": "rqtrace_overhead"})
+        if rq is not None:
+            rq.pop("probe_history", None)
+            result["rqtrace_overhead"] = rq
+            print(json.dumps(result), flush=True)
+
     # prefix_cache: N requests sharing a forced decoder prefix, COW
     # page-fork cache on vs off, outputs asserted bitwise equal
     # (docs/SERVING.md §Prefix cache).
@@ -1122,6 +1138,102 @@ def bench_router_throughput(platform):
         "replicas_used": routed_to,
         "replicas": n_rep, "slots_each": slots,
         "requests": n_req, "clients": clients,
+    }))
+
+
+def bench_rqtrace_overhead(platform):
+    """Secondary metric: router tokens/sec with fleet-wide request
+    tracing ON (``MX_RQTRACE=1``, ``MX_RQTRACE_SAMPLE=1.0`` — every
+    request minted, propagated, span-wrapped at router AND replica)
+    vs ``MX_RQTRACE=0``, telemetry enabled in BOTH modes so the delta
+    isolates the tracing layer: header mint/parse, /tracez bookkeeping,
+    the serve_route/serve_dispatch/serve_handle spans and the engine's
+    per-request span gating (docs/OBSERVABILITY.md §Request tracing).
+    Acceptance bar is <2% overhead (value >= 0.98) — same interleaved
+    interquartile-mean estimator as telemetry_overhead (this box drifts
+    2x at sub-second scale)."""
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    mx, ctx, on_tpu = _common_setup(platform)
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.transformer import Transformer
+    from mxnet_tpu.serving import (ReplicaServer, Request, Router,
+                                   ServingEngine, TransformerAdapter)
+
+    n_req = int(os.environ.get("BENCH_RQTRACE_REQUESTS", 16))
+    clients = int(os.environ.get("BENCH_RQTRACE_CLIENTS", 4))
+    trials = int(os.environ.get("BENCH_RQTRACE_TRIALS", 12))
+
+    mx.random.seed(0)
+    net = Transformer(64, units=32, hidden_size=64, num_heads=4,
+                      num_layers=2, max_length=64, dropout=0.0)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(3, 64, 8).tolist() for _ in range(n_req)]
+
+    tmp = tempfile.mkdtemp(prefix="bench_rqtrace_")
+    telemetry.enable(tmp)
+    eng = ServingEngine(TransformerAdapter(net, src_max_len=8),
+                        slots=4, page_size=8, max_len=40,
+                        stream_every=4, ctx=ctx)
+    eng.serve([Request(prompts[0], 4, bos_id=2, eos_id=1)])  # warm
+    rep = ReplicaServer(eng, bos_id=2, eos_id=1, rank=0, port=0,
+                        directory=tmp).start()
+    router = Router(tmp, port=0, health_sec=60.0).start()
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300.0) as r:
+            return json.load(r)
+
+    bodies = [{"prompt": prompts[i], "max_new_tokens": 12,
+               "bos_id": 2, "eos_id": 1, "timeout_s": 300.0}
+              for i in range(n_req)]
+
+    def one_trial(traced):
+        os.environ["MX_RQTRACE"] = "1" if traced else "0"
+        os.environ["MX_RQTRACE_SAMPLE"] = "1.0"
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=clients) as ex:
+            outs = list(ex.map(post, bodies))
+        wall = time.perf_counter() - t0
+        toks = sum(len(o["tokens"]) for o in outs)
+        return wall, toks, outs
+
+    one_trial(False)
+    _, _, outs_warm = one_trial(True)  # warm both paths
+    traced_ok = all("trace_id" in o for o in outs_warm)
+    offs, ons, toks = [], [], 0
+    for _ in range(trials):
+        w_off, t_off, _ = one_trial(False)
+        offs.append(w_off)
+        w_on, t_on, _ = one_trial(True)
+        ons.append(w_on)
+        assert t_on == t_off, "tracing must not perturb decode"
+        toks = t_on
+    os.environ.pop("MX_RQTRACE", None)
+    os.environ.pop("MX_RQTRACE_SAMPLE", None)
+    router.stop()
+    rep.stop()
+
+    iq_off, iq_on = _iq_mean(offs), _iq_mean(ons)
+    print(json.dumps({
+        "metric": "rqtrace_overhead",
+        "value": round(iq_off / iq_on, 4),
+        "unit": "x_on_vs_off",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "on_tokens_per_sec": round(toks / iq_on, 2),
+        "off_tokens_per_sec": round(toks / iq_off, 2),
+        "all_traced": bool(traced_ok),
+        "requests": n_req, "clients": clients, "trials": trials,
     }))
 
 
@@ -1918,6 +2030,8 @@ def child_main(platform):
         bench_serving_throughput(platform)
     elif model == "router_throughput":
         bench_router_throughput(platform)
+    elif model == "rqtrace_overhead":
+        bench_rqtrace_overhead(platform)
     elif model == "prefix_cache":
         bench_prefix_cache(platform)
     elif model == "spec_decode":

@@ -271,6 +271,28 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "tokens; 0/unset = no SLO): violations bump "
         "mx_serve_slo_violations_total{stage=\"tpot\"} "
         "(telemetry.record_serve_request)"),
+    # fleet-wide request tracing (docs/OBSERVABILITY.md §Request tracing)
+    "MX_RQTRACE": (
+        "honored", "0/false/off disables serving request tracing end to "
+        "end — no trace minting, no X-MX-Trace header, no /tracez "
+        "bookkeeping (serving/router.py rqtrace_enabled; default on; "
+        "the bench lever for the rqtrace_overhead <2% gate)"),
+    "MX_RQTRACE_SAMPLE": (
+        "honored", "head-based sampling rate in [0,1] for request "
+        "traces (default 1.0): unsampled requests skip span emission "
+        "on the hot path but are measured anyway — an error or TTFT "
+        "SLO breach records their spans retroactively (late_sampled), "
+        "so the tail is never lost (serving/router.py mint_trace)"),
+    "MX_RQTRACE_TRACEZ_K": (
+        "honored", "how many completed request trees the /tracez rings "
+        "keep — the Router's fleet-level ring and each rank's "
+        "telemetry.recent_requests ring (default 32) "
+        "(serving/router.py + telemetry.py)"),
+    "MX_RQTRACE_STRAGGLER_X": (
+        "honored", "tools/serve_report.py labels a replica a straggler "
+        "(and attributes its cause-less slow requests to it) when its "
+        "mean decode ms/token exceeds this multiple of the fleet "
+        "median (default 2.0)"),
     # live metrics endpoint (docs/OBSERVABILITY.md §Live metrics)
     "MX_METRICS_PORT": (
         "honored", "per-rank HTTP /metrics /healthz /statusz endpoint "

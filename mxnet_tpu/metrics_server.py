@@ -36,7 +36,12 @@ a sync; enforced by mxlint's jax-free reachability check on this file):
                  pre-teardown snapshot.  The serving block includes the
                  weight hot-swap generation/counters
                  (``summary()['serving']['weight_generation']`` —
-                 docs/SERVING.md §Weight hot-swap).
+                 docs/SERVING.md §Weight hot-swap);
+  ``/tracez``    the last K completed serving requests (trace id,
+                 attributed cause, latency, SLO verdicts) from the
+                 recorder's bounded ring — the per-rank half of the
+                 router's fleet-level ``/tracez``
+                 (docs/OBSERVABILITY.md §Request tracing).
 
 The server binds ``MX_METRICS_HOST`` (default ``127.0.0.1``; set
 ``0.0.0.0`` to expose it to a cross-host scraper) and runs on daemon
@@ -104,10 +109,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._healthz()
         elif route == "/statusz":
             self._statusz()
+        elif route == "/tracez":
+            self._tracez()
         else:
             self._send(404, "text/plain; charset=utf-8",
                        f"no such route {route!r}; try /metrics /healthz "
-                       "/statusz\n")
+                       "/statusz /tracez\n")
 
     def _metrics(self):
         self._send(200, OPENMETRICS_CONTENT_TYPE,
@@ -132,6 +139,18 @@ class _Handler(BaseHTTPRequestHandler):
             body["memwatch"] = _memwatch.summary()
         except Exception:  # statusz must render even if memwatch breaks
             body["memwatch"] = None
+        self._send(200, "application/json", json.dumps(body) + "\n")
+
+    def _tracez(self):
+        # the per-rank half of the router's /tracez (docs/
+        # OBSERVABILITY.md §Request tracing): the recorder's bounded
+        # ring of recently COMPLETED requests with their trace ids and
+        # attributed causes — rollup-only, same jax-free contract as
+        # the other routes
+        body = {
+            "recent": telemetry.recent_requests(),
+            "time": round(time.time(), 3),
+        }
         self._send(200, "application/json", json.dumps(body) + "\n")
 
     def _send(self, code: int, ctype: str, body: str):

@@ -767,9 +767,11 @@ class ServingEngine:
                 spec = self._spec_k > 0 and self._cache is not None
                 want = self._spec_k + 1 if spec else self._stream_every
                 burst = self._ensure_pages(want)
-                # request ids decoding THIS burst, captured before
-                # _consume can evict finished ones
-                burst_ids = [m.req.id for m in self._slots
+                # request ids decoding THIS burst (with their trace
+                # context), captured before _consume can evict
+                # finished ones
+                burst_ids = [(m.req.id, m.req.trace_id, m.req.sampled)
+                             for m in self._slots
                              if m is not None and not m.done]
                 t_burst0 = time.perf_counter()
                 if spec and burst == self._spec_k + 1:
@@ -803,10 +805,13 @@ class ServingEngine:
                 # retroactive form — the dispatch loop above never pays
                 # for tracing.
                 if telemetry.spans_enabled():
-                    for rid in burst_ids:
-                        telemetry.record_span("serve_decode", t_burst0,
-                                              t_stream1, request_id=rid,
-                                              steps=burst)
+                    for rid, tid, samp in burst_ids:
+                        if tid is not None and not samp:
+                            continue  # head-based sampling dropped it
+                        telemetry.record_span(
+                            "serve_decode", t_burst0, t_stream1,
+                            request_id=rid, steps=burst,
+                            **({"trace_id": tid} if tid else {}))
                     telemetry.record_span("serve_stream", t_stream0,
                                           t_stream1,
                                           active_slots=len(burst_ids),
@@ -1232,14 +1237,16 @@ class ServingEngine:
         """Host-side draft proposals for every live slot: (S, K) int32
         token matrix + (S,) proposal counts (ragged — 0 for empty/done
         slots and for requests the draft has nothing for)."""
+        from .speculative import traced_propose
+
         K = self._spec_k
         draft = np.zeros((self._S, K), np.int32)
         nprop = np.zeros((self._S,), np.int32)
         for slot, meta in enumerate(self._slots):
             if meta is None or meta.done:
                 continue
-            toks = list(self._draft.propose(meta.req,
-                                            meta.req.stream.tokens, K))[:K]
+            toks = list(traced_propose(self._draft, meta.req,
+                                       meta.req.stream.tokens, K))[:K]
             if toks:
                 draft[slot, :len(toks)] = toks
                 nprop[slot] = len(toks)
@@ -1308,7 +1315,14 @@ class ServingEngine:
                     break
         self._spec_proposed += proposed
         self._spec_accepted += accepted
-        telemetry.record_spec_verify(proposed=proposed, accepted=accepted)
+        # the verify boundary is per-burst, not per-request: name the
+        # sampled traces that shared it so serve_report can charge the
+        # rejected-draft work back to each request tree
+        tids = [m.req.trace_id for m in self._slots
+                if m is not None and m.req.trace_id and m.req.sampled]
+        telemetry.record_spec_verify(
+            proposed=proposed, accepted=accepted,
+            **({"trace_ids": tids} if tids else {}))
         for slot, meta in enumerate(self._slots):
             if meta is not None and meta.done:
                 self._evict(slot, meta)
@@ -1343,14 +1357,28 @@ class ServingEngine:
 
     def _admit(self, slot: int, req: Request) -> bool:
         st = self._state
+        if req.generation_at_admit is None:
+            # cause attribution: a request admitted under generation G
+            # that finishes under G' > G decoded across a weight-swap
+            # window (scheduler.Request §Request tracing)
+            req.generation_at_admit = self._weight_generation
         # the queue leg of the request-id span tree: queue-start ->
         # admit, recorded retroactively from the scheduler's SLO stamps
         # (t_queue_start, not t_submit: a preempted request's re-queue
         # span must not swallow its first admission's prefill+decode)
         if req.t_queue_start is not None and req.t_admit is not None \
-                and telemetry.spans_enabled():
-            telemetry.record_span("serve_queue", req.t_queue_start,
-                                  req.t_admit, request_id=req.id)
+                and telemetry.spans_enabled() \
+                and (req.trace_id is None or req.sampled):
+            telemetry.record_span(
+                "serve_queue", req.t_queue_start, req.t_admit,
+                request_id=req.id,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
+        if self._cache is not None:
+            # pool-pressure attribution: a denied page grant for this
+            # slot now names the request (and trace) it starved
+            self._cache.annotate(
+                slot, request_id=req.id,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
         src = self._adapter.prefill_src(req)
         if src is not None:
             self._prefill_into(slot, req, src)
@@ -1383,8 +1411,12 @@ class ServingEngine:
                 for name in names:
                     st[name][slot] = e["payload"]["rows"][name]
                 req.prefill_ms = 0.0
+                if req.prefix_hit is None:
+                    req.prefix_hit = True
                 telemetry.record_serve_prefix(
-                    kind="prefill", hit=True, tokens=int(req.tokens.size))
+                    kind="prefill", hit=True, tokens=int(req.tokens.size),
+                    request_id=req.id,
+                    **({"trace_id": req.trace_id} if req.trace_id else {}))
                 return
         self._ensure_prefill(src)
         import jax.numpy as jnp
@@ -1395,9 +1427,11 @@ class ServingEngine:
         # prefill_ms is DISPATCH wall (async queueing, like step
         # events — see telemetry.record_step's contract)
         req.prefill_ms = round((t1 - t0) * 1e3, 3)
-        if telemetry.spans_enabled():
-            telemetry.record_span("serve_prefill", t0, t1,
-                                  request_id=req.id)
+        if telemetry.spans_enabled() \
+                and (req.trace_id is None or req.sampled):
+            telemetry.record_span(
+                "serve_prefill", t0, t1, request_id=req.id,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
         if "serving_prefill" in self._pending_compile:
             self._pending_compile["serving_prefill"].setdefault(
                 "wall_s", time.perf_counter() - t0)
@@ -1414,8 +1448,11 @@ class ServingEngine:
                                       self._weight_generation,
                                       {"rows": rows, "owner": None}):
                 self._release_prefix_entry(d)
+            req.prefix_hit = False
             telemetry.record_serve_prefix(
-                kind="prefill", hit=False, tokens=int(req.tokens.size))
+                kind="prefill", hit=False, tokens=int(req.tokens.size),
+                request_id=req.id,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
 
     def _install_sampling(self, slot: int, req: Request) -> None:
         """Per-slot sampling state at admission.  The RNG key is a pure
@@ -1452,8 +1489,11 @@ class ServingEngine:
             e = self._prefix.get(key, self._weight_generation)
             if e is not None and self._fork_from_entry(slot, e, req):
                 meta.pos = T
-                telemetry.record_serve_prefix(kind="pages", hit=True,
-                                              tokens=T)
+                if req.prefix_hit is None:
+                    req.prefix_hit = True
+                telemetry.record_serve_prefix(
+                    kind="pages", hit=True, tokens=T, request_id=req.id,
+                    **({"trace_id": req.trace_id} if req.trace_id else {}))
                 return True
         need = pages_for(T, self._ps) - len(self._cache.owned(slot))
         if not self._alloc_prefix_pages(slot, need):
@@ -1463,8 +1503,10 @@ class ServingEngine:
         meta.pos = T
         if key is not None:
             self._register_prefix(slot, key, T)
-            telemetry.record_serve_prefix(kind="pages", hit=False,
-                                          tokens=T)
+            req.prefix_hit = False
+            telemetry.record_serve_prefix(
+                kind="pages", hit=False, tokens=T, request_id=req.id,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
         return True
 
     def _fork_from_entry(self, slot: int, e: dict, req: Request) -> bool:
@@ -1556,10 +1598,12 @@ class ServingEngine:
                 self._state[name] = NDArray(arr, ctx=self._ctx)
             done += n
         self._state["tok"][slot, 0] = int(req.prefix[-1])
-        if telemetry.spans_enabled():
-            telemetry.record_span("serve_ingest", t0,
-                                  time.perf_counter(),
-                                  request_id=req.id, tokens=T)
+        if telemetry.spans_enabled() \
+                and (req.trace_id is None or req.sampled):
+            telemetry.record_span(
+                "serve_ingest", t0, time.perf_counter(),
+                request_id=req.id, tokens=T,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
 
     def _alloc_prefix_pages(self, slot: int, n: int) -> bool:
         """Allocate ``n`` pages for a prefix, dropping LRU cache entries
@@ -1680,8 +1724,11 @@ class ServingEngine:
         req.t_first_token = None  # TTFT re-stamps after re-admission,
         #                           still measured from the ORIGINAL submit
         req.prefill_ms = 0.0
+        req.preemptions += 1
         telemetry.record("serve_preempt", request_id=req.id,
-                         decoded=meta.pos)
+                         decoded=meta.pos,
+                         **({"trace_id": req.trace_id}
+                            if req.trace_id else {}))
         self._sched.requeue(req)
         self._slots[slot] = None
 
@@ -1734,13 +1781,30 @@ class ServingEngine:
         # the SLO latency must include the discarded service period
         total_ms = ((now - req.t_submit) * 1e3
                     if req.t_submit is not None else None)
+        # per-request cause attribution from the breadcrumbs stamped as
+        # the request moved through the engine, in priority order: a
+        # recompute-preemption dominates (it rewinds the whole stream),
+        # then a weight-swap window crossing, then a prefix-cache miss
+        # (a request with no prefix candidate attributes to "none")
+        if req.preemptions:
+            cause = "preempt"
+        elif (req.generation_at_admit is not None
+              and req.generation_at_admit != self._weight_generation):
+            cause = "swap"
+        elif req.prefix_hit is False:
+            cause = "cache_miss"
+        else:
+            cause = "none"
         telemetry.record_serve_request(
             queue_wait_ms=req.queue_wait_ms, prefill_ms=req.prefill_ms,
             decode_ms=round(decode_ms, 3), tokens=len(req.stream),
             ttft_ms=round(req.ttft_ms, 3),
             total_ms=round(total_ms, 3) if total_ms is not None else None,
             request_id=req.id, reason=req.stream.finish_reason,
-            precision=self._precision)
+            precision=self._precision, cause=cause,
+            preemptions=req.preemptions,
+            **({"trace_id": req.trace_id, "sampled": req.sampled}
+               if req.trace_id else {}))
         self._slots[slot] = None
 
     # ------------------------------------------------------------------

@@ -216,6 +216,7 @@ class PagedKVCache:
         self._free: List[int] = list(range(1, self.num_pages))
         self._owned: dict = {}
         self._refs: dict = {}  # page -> owner count (COW sharing)
+        self._notes: dict = {}  # owner -> observability metadata
 
     @property
     def pages_free(self) -> int:
@@ -228,6 +229,18 @@ class PagedKVCache:
         """How many owners hold ``page`` (0 = free/never granted)."""
         return self._refs.get(int(page), 0)
 
+    def annotate(self, owner, **attrs) -> None:
+        """Attach observability metadata to ``owner`` (the serving
+        engine stamps request_id/trace_id at admission) so pool-pressure
+        events name the request whose growth was denied, not just a
+        slot index.  Cleared when the owner releases its pages."""
+        if attrs:
+            self._notes.setdefault(owner, {}).update(attrs)
+
+    def annotation(self, owner) -> dict:
+        """The metadata :meth:`annotate` attached (empty dict if none)."""
+        return dict(self._notes.get(owner, ()))
+
     def alloc(self, slot, n_pages: int) -> Optional[List[int]]:
         """Grant ``n_pages`` more pages to ``slot`` (all-or-nothing).
         Returns the newly granted pages, or None when the pool cannot
@@ -238,6 +251,16 @@ class PagedKVCache:
         if n_pages <= 0:
             return []
         if n_pages > len(self._free):
+            # pool pressure, attributed: the denial that triggers burst
+            # shrink / preemption upstream names the starved request via
+            # its annotation (at most one event per owner per growth
+            # pass — the engine never retries a denied all-or-nothing
+            # grant within a pass)
+            from .. import telemetry
+
+            telemetry.record("serve_pool_pressure", want=n_pages,
+                             free=len(self._free),
+                             **self._notes.get(slot, {}))
             return None
         got = [self._free.pop() for _ in range(n_pages)]
         self._owned.setdefault(slot, []).extend(got)
@@ -269,6 +292,7 @@ class PagedKVCache:
         last owner lets go.  Returns how many pages actually came back
         to the free list."""
         pages = self._owned.pop(slot, [])
+        self._notes.pop(slot, None)
         freed = 0
         for p in pages:
             left = self._refs.get(p, 1) - 1

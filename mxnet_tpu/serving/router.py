@@ -39,16 +39,34 @@ configured pair), ``temperature``/``top_k``/``top_p``/``seed``
 engine), ``prefix`` (forced decoder prefix; prefix-cache candidate),
 ``session`` (router affinity key), ``timeout_s``.  Response:
 ``{"request_id", "tokens", "finish_reason", "replica", ...}``.
+
+**Request tracing** (docs/OBSERVABILITY.md §Request tracing): the Router
+mints a trace context per /generate — ``trace_id`` (16 hex chars), the
+id of its open ``serve_route`` span, and a head-sampling bit
+(``MX_RQTRACE_SAMPLE``, default 1.0) — and propagates it to the replica
+in the ``X-MX-Trace`` header (``<trace_id>;parent=<span>;sampled=<0|1>``).
+The replica threads it into the :class:`~.scheduler.Request` so every
+engine span/event carries the trace id; the router wraps the whole
+dispatch residence in a paired ``serve_route`` span and each attempt in
+a ``serve_dispatch`` span (a failover is ONE trace with TWO dispatch
+spans).  Unsampled requests skip span emission on the hot path but the
+router still measures them — on an error or TTFT SLO breach the spans
+are recorded retroactively (``late_sampled``), so the tail is never
+lost.  ``GET /tracez`` shows the last K completed request trees
+(``MX_RQTRACE_TRACEZ_K``) and every in-flight request with its open
+span.  ``MX_RQTRACE=0`` switches the whole subsystem off.
 """
 from __future__ import annotations
 
 import json
 import logging
 import os
+import random
 import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -57,7 +75,8 @@ from ..base import MXNetError
 from .scheduler import Request
 
 __all__ = ["ReplicaServer", "Router", "serve_portfile_path",
-           "discover_replicas"]
+           "discover_replicas", "TRACE_HEADER", "rqtrace_enabled",
+           "mint_trace", "format_trace_header", "parse_trace_header"]
 
 _LOG = logging.getLogger("mxnet_tpu.serving.router")
 
@@ -100,6 +119,64 @@ def discover_replicas(directory: str) -> List[dict]:
                         "port": int(p["port"])})
         except (OSError, ValueError, KeyError, TypeError):
             continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trace context (docs/OBSERVABILITY.md §Request tracing)
+# ---------------------------------------------------------------------------
+TRACE_HEADER = "X-MX-Trace"
+
+
+def rqtrace_enabled() -> bool:
+    """Request tracing rides the front door by default; ``MX_RQTRACE=0``
+    is the kill switch (spans, /tracez bookkeeping and header
+    propagation all stop — the bench lever for the <2% overhead gate)."""
+    return os.environ.get("MX_RQTRACE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def mint_trace(sample: Optional[float] = None) -> Optional[dict]:
+    """A fresh trace context ``{"trace_id", "sampled"}`` — or None with
+    ``MX_RQTRACE=0``.  Head-based sampling: the bit is decided HERE,
+    once, and propagated, so one request is either traced on every hop
+    or on none (``MX_RQTRACE_SAMPLE``, default 1.0).  Trace ids are 16
+    hex chars of ``os.urandom`` — no coordination, no clock."""
+    if not rqtrace_enabled():
+        return None
+    rate = _env_float("MX_RQTRACE_SAMPLE", 1.0) if sample is None \
+        else float(sample)
+    sampled = rate >= 1.0 or (rate > 0.0 and random.random() < rate)
+    return {"trace_id": os.urandom(8).hex(), "sampled": sampled}
+
+
+def format_trace_header(trace_id: str, parent_span_id: int = 0,
+                        sampled: bool = True) -> str:
+    return f"{trace_id};parent={int(parent_span_id)};" \
+           f"sampled={1 if sampled else 0}"
+
+
+def parse_trace_header(value: Optional[str]) -> Optional[dict]:
+    """Parse an ``X-MX-Trace`` header into ``{"trace_id", "parent",
+    "sampled"}``; garbage (wrong field count, non-int parent) returns
+    None — an upstream that speaks a different dialect downgrades to
+    untraced, never to a 500."""
+    if not value:
+        return None
+    parts = value.strip().split(";")
+    trace_id = parts[0].strip()
+    if not trace_id or len(trace_id) > 64:
+        return None
+    out = {"trace_id": trace_id, "parent": 0, "sampled": True}
+    for part in parts[1:]:
+        key, _, raw = part.strip().partition("=")
+        if key == "parent":
+            try:
+                out["parent"] = int(raw)
+            except ValueError:
+                return None
+        elif key == "sampled":
+            out["sampled"] = raw.strip() not in ("0", "false")
     return out
 
 
@@ -176,8 +253,9 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         except (ValueError, UnicodeDecodeError) as e:
             _send(self, 400, {"error": f"bad JSON body: {e}"})
             return
+        trace = parse_trace_header(self.headers.get(TRACE_HEADER))
         try:
-            result = rep.generate(body)
+            result = rep.generate(body, trace=trace)
         except MXNetError as e:
             # backpressure (queue full) and validation errors are the
             # client's 4xx/503, never a replica crash
@@ -301,12 +379,26 @@ class ReplicaServer:
                 return
 
     # ---- handler-side operations (jax-free) --------------------------
-    def generate(self, body: dict) -> dict:
+    def generate(self, body: dict, trace: Optional[dict] = None) -> dict:
+        """Build + submit one Request and poll it to completion.
+
+        ``trace`` is the parsed ``X-MX-Trace`` context the Router
+        propagated; a direct client (no header) gets a replica-minted
+        one so single-replica deployments still trace.  Sampled requests
+        run inside a paired ``serve_handle`` span (the replica-side root
+        of the request tree — its open begin is the "died inside X"
+        clue); unsampled ones are measured anyway and the span recorded
+        retroactively on an error or TTFT SLO breach."""
         defaults = _sampling_defaults()
         prompt = body.get("prompt")
         if not isinstance(prompt, list) or not prompt:
             raise MXNetError("/generate body needs a non-empty 'prompt' "
                              "list of token ids")
+        if trace is None:
+            trace = mint_trace()
+        tid = trace["trace_id"] if trace else None
+        sampled = bool(trace.get("sampled", True)) if trace else True
+        upstream = int(trace.get("parent", 0)) if trace else 0
         req = Request(
             prompt,
             max_new_tokens=int(body.get("max_new_tokens", 16)),
@@ -319,8 +411,47 @@ class ReplicaServer:
             top_p=float(body.get("top_p", defaults["top_p"])),
             seed=body.get("seed"),
             prefix=body.get("prefix"),
-            session=body.get("session"))
+            session=body.get("session"),
+            trace_id=tid, parent_span_id=upstream, sampled=sampled)
         timeout_s = float(body.get("timeout_s", 120.0))
+        if tid and sampled and telemetry.spans_enabled():
+            with telemetry.span("serve_handle", paired=True,
+                                trace_id=tid, request_id=req.id,
+                                replica=self.rank,
+                                upstream_span=upstream):
+                self._serve_wait(req, timeout_s)
+        else:
+            t0 = time.perf_counter()
+            try:
+                self._serve_wait(req, timeout_s)
+            except BaseException as e:
+                if tid:  # always-sample the tail: errors keep their span
+                    telemetry.record_span(
+                        "serve_handle", t0, time.perf_counter(),
+                        trace_id=tid, request_id=req.id,
+                        replica=self.rank, late_sampled=True,
+                        error=type(e).__name__)
+                raise
+            slo = _env_float("MX_SERVE_SLO_TTFT_MS", 0.0)
+            if tid and slo > 0 and req.ttft_ms > slo:
+                telemetry.record_span(
+                    "serve_handle", t0, time.perf_counter(),
+                    trace_id=tid, request_id=req.id, replica=self.rank,
+                    late_sampled=True, slo_stage="ttft")
+        out = {"request_id": req.id,
+               "tokens": [int(t) for t in req.stream],
+               "finish_reason": req.stream.finish_reason,
+               "replica": self.rank,
+               "generation": self.engine.weight_generation,
+               "session": req.session,
+               "ttft_ms": round(req.ttft_ms, 3),
+               "queue_wait_ms": round(req.queue_wait_ms, 3)}
+        if tid:
+            out["trace_id"] = tid
+            out["sampled"] = sampled
+        return out
+
+    def _serve_wait(self, req: Request, timeout_s: float) -> None:
         self._outstanding += 1
         try:
             self.engine.submit(req)
@@ -338,14 +469,6 @@ class ReplicaServer:
                 time.sleep(0.002)
         finally:
             self._outstanding -= 1
-        return {"request_id": req.id,
-                "tokens": [int(t) for t in req.stream],
-                "finish_reason": req.stream.finish_reason,
-                "replica": self.rank,
-                "generation": self.engine.weight_generation,
-                "session": req.session,
-                "ttft_ms": round(req.ttft_ms, 3),
-                "queue_wait_ms": round(req.queue_wait_ms, 3)}
 
     def drain(self) -> None:
         self.draining = True
@@ -383,6 +506,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif route == "/healthz":
             snap = router.healthz()
             _send(self, 200 if snap["ok"] else 503, snap)
+        elif route == "/tracez":
+            _send(self, 200, router.tracez())
         else:
             _send(self, 404, {"error": f"no such route {route!r}"})
 
@@ -452,6 +577,13 @@ class Router:
         self.port = 0
         self.dispatched = 0
         self.failovers = 0
+        # /tracez surfaces (§Request tracing): trace_id -> in-flight
+        # request with its currently open span, + a bounded ring of the
+        # last K completed request trees (attempt list = the span tree's
+        # dispatch children, failovers included)
+        self._inflight: Dict[str, dict] = {}
+        self._completed: deque = deque(
+            maxlen=max(1, _env_int("MX_RQTRACE_TRACEZ_K", 32)))
         self.refresh()
 
     # ---- lifecycle ---------------------------------------------------
@@ -555,10 +687,55 @@ class Router:
         """Route one /generate body; returns (http_code, payload).
         Connection-level failures mark the replica dead and fail the
         request over; HTTP-level errors (4xx validation, 503 back-
-        pressure) are the replica's verdict and pass through."""
+        pressure) are the replica's verdict and pass through.
+
+        Tracing (§Request tracing): mints the trace context, wraps the
+        whole residence in a paired ``serve_route`` span whose id rides
+        the outgoing header as ``parent=``, tracks the request in the
+        /tracez in-flight table, and archives it to the completed ring
+        on the way out.  A failed-over request stays ONE trace — its
+        attempt list (and span tree) just grows a second dispatch."""
+        trace = mint_trace()
+        if trace is None:  # MX_RQTRACE=0: the untraced fast path
+            return self._dispatch_attempts(body, None, 0, None)
+        tid = trace["trace_id"]
+        entry = {"trace_id": tid, "request_id": body.get("request_id"),
+                 "session": body.get("session"),
+                 "sampled": trace["sampled"], "open_span": "serve_route",
+                 "replica": None, "started": round(time.time(), 3),
+                 "attempts": []}
+        with self._lock:
+            self._inflight[tid] = entry
+        t0 = time.perf_counter()
+        code, payload = None, None
+        try:
+            if trace["sampled"] and telemetry.spans_enabled():
+                with telemetry.span(
+                        "serve_route", paired=True, trace_id=tid,
+                        request_id=body.get("request_id"),
+                        session=body.get("session")) as sp:
+                    code, payload = self._dispatch_attempts(
+                        body, trace, sp.span_id, entry)
+            else:
+                code, payload = self._dispatch_attempts(
+                    body, trace, 0, entry)
+        finally:
+            self._finish_trace(trace, entry, code, payload, t0,
+                               time.perf_counter())
+        return code, payload
+
+    def _dispatch_attempts(self, body: dict, trace: Optional[dict],
+                           parent_span: int, entry: Optional[dict]):
+        """The pick→POST→failover loop (one iteration per attempt)."""
         session = body.get("session")
         timeout_s = float(body.get("timeout_s", 120.0))
         raw = json.dumps(body).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        tid = trace["trace_id"] if trace else None
+        sampled = bool(trace.get("sampled", True)) if trace else False
+        if trace is not None:
+            headers[TRACE_HEADER] = format_trace_header(
+                tid, parent_span, sampled)
         tried: set = set()
         while True:
             rep = self._pick(session, tried)
@@ -567,8 +744,14 @@ class Router:
                              "tried": sorted(tried)}
             tried.add(rep["rank"])
             req = urllib.request.Request(
-                rep["url"] + "/generate", data=raw,
-                headers={"Content-Type": "application/json"})
+                rep["url"] + "/generate", data=raw, headers=headers)
+            attempt = {"rank": rep["rank"], "t0": time.perf_counter(),
+                       "t1": None, "ms": 0.0, "error": None}
+            if entry is not None:
+                with self._lock:
+                    entry["open_span"] = "serve_dispatch"
+                    entry["replica"] = rep["rank"]
+                    entry["attempts"].append(attempt)
             with self._lock:
                 cur = self._replicas.get(rep["rank"])
                 if cur is not None:
@@ -586,6 +769,7 @@ class Router:
                 except (ValueError, OSError):
                     payload = {"error": f"replica HTTP {e.code}"}
                 payload["routed_to"] = rep["rank"]
+                attempt["error"] = f"HTTP {e.code}"
                 return e.code, payload
             except (urllib.error.URLError, OSError) as e:
                 # connection-level death: mark dead, fail over
@@ -594,16 +778,72 @@ class Router:
                     if cur is not None:
                         cur["healthy"] = False
                 self.failovers += 1
+                attempt["error"] = str(e)[:200]
                 telemetry.record("serve_failover", executor="Router",
-                                 rank=rep["rank"], error=str(e)[:200])
+                                 rank=rep["rank"], error=str(e)[:200],
+                                 trace_id=tid)
+                telemetry.record_serve_cause(
+                    "failover", trace_id=tid, rank=rep["rank"])
                 _LOG.warning("replica %d unreachable (%s); failing over",
                              rep["rank"], e)
             finally:
+                attempt["t1"] = time.perf_counter()
+                attempt["ms"] = (attempt["t1"] - attempt["t0"]) * 1e3
+                if tid and sampled:
+                    attrs = {"trace_id": tid, "replica": rep["rank"]}
+                    if attempt["error"]:
+                        attrs["error"] = attempt["error"]
+                    telemetry.record_span("serve_dispatch",
+                                          attempt["t0"], attempt["t1"],
+                                          **attrs)
                 with self._lock:
                     cur = self._replicas.get(rep["rank"])
                     if cur is not None:
                         cur["outstanding"] = max(
                             0, cur["outstanding"] - 1)
+
+    def _finish_trace(self, trace: dict, entry: dict,
+                      code: Optional[int], payload, t0: float,
+                      t1: float) -> None:
+        """Archive one traced dispatch: /tracez completed-ring entry +
+        retroactive span emission for an UNSAMPLED request that erred or
+        breached the TTFT SLO (always-sample the tail)."""
+        tid = trace["trace_id"]
+        ttft = float(payload.get("ttft_ms", 0.0)) \
+            if isinstance(payload, dict) else 0.0
+        if isinstance(payload, dict):
+            payload.setdefault("trace_id", tid)
+        slo = _env_float("MX_SERVE_SLO_TTFT_MS", 0.0)
+        breach = slo > 0 and ttft > slo
+        errorish = code is None or code >= 500
+        if not trace["sampled"] and (errorish or breach) \
+                and telemetry.spans_enabled():
+            telemetry.record_span(
+                "serve_route", t0, t1, trace_id=tid,
+                request_id=entry["request_id"], late_sampled=True,
+                code=code)
+            for a in entry["attempts"]:
+                attrs = {"trace_id": tid, "replica": a["rank"],
+                         "late_sampled": True}
+                if a["error"]:
+                    attrs["error"] = a["error"]
+                telemetry.record_span("serve_dispatch", a["t0"],
+                                      a["t1"] or t1, **attrs)
+        done = {"trace_id": tid, "request_id": entry["request_id"]
+                if entry["request_id"] is not None else
+                (payload.get("request_id")
+                 if isinstance(payload, dict) else None),
+                "session": entry["session"], "code": code,
+                "latency_ms": round((t1 - t0) * 1e3, 3),
+                "ttft_ms": round(ttft, 3), "replica": entry["replica"],
+                "sampled": trace["sampled"], "slo_breach": breach,
+                "attempts": [{"rank": a["rank"],
+                              "ms": round(a["ms"], 3),
+                              "error": a["error"]}
+                             for a in entry["attempts"]]}
+        with self._lock:
+            self._inflight.pop(tid, None)
+            self._completed.append(done)
 
     # ---- admin + introspection ---------------------------------------
     def set_drain(self, rank: int, draining: bool) -> bool:
@@ -644,4 +884,35 @@ class Router:
                 "dispatched": self.dispatched,
                 "failovers": self.failovers,
                 "health_sec": self.health_sec,
+                "time": round(time.time(), 3)}
+
+    def tracez(self) -> dict:
+        """The /tracez payload (§Request tracing): the last K completed
+        request trees (newest last; attempt list = dispatch spans,
+        failovers included) and every in-flight request with its open
+        span + elapsed — the fleet edition of the flight recorder's
+        "died inside X" clue."""
+        now = time.perf_counter()
+        with self._lock:
+            completed = [dict(c) for c in self._completed]
+            inflight = []
+            for e in self._inflight.values():
+                open_t0 = (e["attempts"][-1]["t0"] if e["attempts"]
+                           and e["open_span"] == "serve_dispatch"
+                           else None)
+                inflight.append({
+                    "trace_id": e["trace_id"],
+                    "request_id": e["request_id"],
+                    "session": e["session"], "sampled": e["sampled"],
+                    "open_span": e["open_span"],
+                    "replica": e["replica"],
+                    "started": e["started"],
+                    "open_span_elapsed_ms": round(
+                        (now - open_t0) * 1e3, 3)
+                    if open_t0 is not None else None,
+                    "attempts": len(e["attempts"])})
+        return {"enabled": rqtrace_enabled(),
+                "sample": _env_float("MX_RQTRACE_SAMPLE", 1.0),
+                "k": self._completed.maxlen,
+                "in_flight": inflight, "completed": completed,
                 "time": round(time.time(), 3)}

@@ -90,13 +90,27 @@ class Request:
     decode starts, and — with the prefix cache on — shares those pages
     across requests with an identical (source, prefix) instead of
     recomputing them.  ``session`` is an opaque affinity id the router
-    uses to pin a conversation to one replica."""
+    uses to pin a conversation to one replica.
+
+    Trace context (docs/OBSERVABILITY.md §Request tracing):
+    ``trace_id`` is the fleet-wide correlation id the Router minted (or
+    the replica minted for direct clients) and propagated via the
+    ``X-MX-Trace`` header; every serving span/event the engine emits for
+    this request carries it, so the merged gang trace renders ONE
+    cross-process tree per request.  ``parent_span_id`` is the upstream
+    (router-side) span id, informational only — cross-process linking
+    happens through flow events keyed on the trace id, never on local
+    span ids.  ``sampled=False`` (head-based sampling, MX_RQTRACE_SAMPLE)
+    suppresses the request's per-request SPANS; events and SLO
+    accounting always run."""
 
     def __init__(self, tokens, max_new_tokens: int, bos_id: int,
                  eos_id: int, request_id: Optional[str] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: Optional[int] = None,
-                 prefix=None, session: Optional[str] = None):
+                 prefix=None, session: Optional[str] = None,
+                 trace_id: Optional[str] = None, parent_span_id: int = 0,
+                 sampled: bool = True):
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         if self.max_new_tokens < 1:
@@ -116,6 +130,16 @@ class Request:
         self.prefix = (np.zeros((0,), np.int32) if prefix is None
                        else np.asarray(prefix, np.int32).reshape(-1))
         self.session = session
+        self.trace_id = trace_id
+        self.parent_span_id = int(parent_span_id)
+        self.sampled = bool(sampled)
+        # cause-attribution breadcrumbs the engine stamps as the request
+        # moves: preemption count, prefix-cache verdict (None = no prefix
+        # candidate), and the weight generation that admitted it — the
+        # inputs to the per-request `cause` field on serve_request
+        self.preemptions = 0
+        self.prefix_hit: Optional[bool] = None
+        self.generation_at_admit: Optional[int] = None
         self.id = request_id if request_id is not None \
             else f"req{next(_ids)}"
         self.stream = TokenStream()

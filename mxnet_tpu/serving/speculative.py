@@ -26,7 +26,26 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-__all__ = ["DraftProposer", "NGramDraft"]
+__all__ = ["DraftProposer", "NGramDraft", "traced_propose"]
+
+
+def traced_propose(draft: "DraftProposer", request,
+                   generated: Sequence[int], k: int) -> List[int]:
+    """Call ``draft.propose`` and, for a sampled traced request, stamp a
+    ``spec_draft`` event naming the trace (docs/OBSERVABILITY.md
+    §Request tracing).  The engine routes every proposal through this
+    seam so draft implementations stay arbitrary telemetry-free host
+    code — the ``propose`` contract above is unchanged."""
+    out = draft.propose(request, generated, k)
+    tid = getattr(request, "trace_id", None)
+    if tid and getattr(request, "sampled", True):
+        from .. import telemetry
+
+        if telemetry.spans_enabled():
+            telemetry.record("spec_draft", trace_id=tid,
+                             request_id=request.id,
+                             proposed=len(out))
+    return out
 
 
 class DraftProposer:

@@ -85,6 +85,7 @@ from typing import Any, Dict, List, Optional
 __all__ = ["enabled", "enable", "disable", "record", "record_step",
            "record_collective", "record_fused_update", "record_block_wait",
            "record_serve_request", "record_serve_state",
+           "record_serve_cause", "recent_requests",
            "heartbeat", "note_signature", "summary", "flight_tail", "flush",
            "reset", "rank", "event_path", "heartbeat_path", "RING_SIZE",
            "span", "record_span", "spans_enabled", "export_chrome_trace",
@@ -186,7 +187,18 @@ class _State:
                       # lifetime draft tokens proposed/accepted — the
                       # acceptance rate IS the speedup lever
                       "spec_rounds": 0, "spec_proposed": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0,
+                      # request-tracing cause attribution (docs/
+                      # OBSERVABILITY.md §Request tracing): completed
+                      # requests bucketed by attributed tail cause
+                      # (preempt/swap/cache_miss/failover/none) + one
+                      # exemplar trace id per cause — the prometheus
+                      # exemplar stand-in, bounded at one series/cause
+                      "causes": {}, "cause_exemplars": {}}
+        # newest completed requests (request_id/trace_id/cause/latency):
+        # the per-rank /tracez ring metrics_server serves, sized by
+        # MX_RQTRACE_TRACEZ_K at enable() (default 32)
+        self.serve_recent: deque = deque(maxlen=32)
         # newest in-flight dispatch-window depth any executor reported
         # (record_step's inflight_depth field) — a /healthz input
         self.inflight_depth = 0
@@ -230,6 +242,9 @@ def enable(directory: Optional[str] = None) -> None:
         _state.rank = rank()
         _state.flush_sec = max(0.05, _env_float("MX_TELEMETRY_FLUSH_SEC", 1.0))
         _state.hb_interval = max(0.0, _env_float("MX_HEARTBEAT_SEC", 5.0))
+        k = max(1, int(_env_float("MX_RQTRACE_TRACEZ_K", 32)))
+        if k != _state.serve_recent.maxlen:
+            _state.serve_recent = deque(_state.serve_recent, maxlen=k)
         _state.enabled = True
         if _state.flusher is None:
             _state.flusher = threading.Thread(
@@ -336,6 +351,8 @@ class _NullSpan:
 
     __slots__ = ()
 
+    span_id = 0  # parity with _Span: propagation call sites need an int
+
     def __enter__(self):
         return self
 
@@ -354,6 +371,14 @@ class _Span:
         self._name = name
         self._attrs = attrs
         self._paired = paired
+        self._id = 0
+
+    @property
+    def span_id(self) -> int:
+        """This span's id once entered (0 before) — what the Router puts
+        in the outgoing ``X-MX-Trace`` ``parent=`` field so a replica can
+        name its upstream span."""
+        return self._id
 
     def __enter__(self):
         stack = getattr(_span_local, "stack", None)
@@ -633,7 +658,14 @@ def record_serve_request(queue_wait_ms: float = 0.0,
     request whose TTFT exceeds the former or whose time-per-output-token
     (decode wall / tokens) exceeds the latter bumps
     ``mx_serve_slo_violations_total{stage=...}`` and leaves a
-    ``serve_slo_violation`` event naming the request."""
+    ``serve_slo_violation`` event naming the request.
+
+    Request tracing (docs/OBSERVABILITY.md §Request tracing): ``trace_id``
+    and ``cause`` travel in ``**fields`` onto the event; a non-``none``
+    cause also bumps the per-cause counter behind
+    ``mx_serve_request_cause_total`` and replaces that cause's exemplar
+    (newest trace id + latency — bounded at one series per cause).  Every
+    completed request additionally lands in the /tracez recent ring."""
     if not _state.enabled:
         return
     latency = (float(total_ms) if total_ms is not None else
@@ -646,6 +678,8 @@ def record_serve_request(queue_wait_ms: float = 0.0,
         violations.append(("ttft", round(float(ttft_ms), 3), slo_ttft))
     if slo_tpot and tpot_ms > slo_tpot:
         violations.append(("tpot", round(tpot_ms, 3), slo_tpot))
+    cause = str(fields.get("cause") or "none")
+    trace_id = fields.get("trace_id")
     with _state.lock:
         sv = _state.serve
         sv["requests"] += 1
@@ -658,6 +692,22 @@ def record_serve_request(queue_wait_ms: float = 0.0,
             sv["ttft_ms"].append(float(ttft_ms))
         for stage, _v, _t in violations:
             sv[f"slo_{stage}"] += 1
+        if cause != "none":
+            sv["causes"][cause] = sv["causes"].get(cause, 0) + 1
+            if trace_id:
+                sv["cause_exemplars"][cause] = {
+                    "trace_id": str(trace_id),
+                    "latency_ms": round(latency, 3)}
+        _state.serve_recent.append({
+            "t": round(time.time(), 3),
+            "request_id": fields.get("request_id"),
+            "trace_id": trace_id,
+            "cause": cause,
+            "latency_ms": round(latency, 3),
+            "ttft_ms": round(float(ttft_ms), 3),
+            "tokens": int(tokens),
+            "reason": fields.get("reason"),
+            "slo_violated": [stage for stage, _v, _t in violations]})
     record("serve_request", queue_wait_ms=round(queue_wait_ms, 3),
            prefill_ms=round(prefill_ms, 3), decode_ms=round(decode_ms, 3),
            latency_ms=round(latency, 3), tokens=int(tokens),
@@ -665,7 +715,38 @@ def record_serve_request(queue_wait_ms: float = 0.0,
     for stage, value_ms, threshold_ms in violations:
         record("serve_slo_violation", stage=stage, value_ms=value_ms,
                threshold_ms=threshold_ms,
-               request_id=fields.get("request_id"))
+               request_id=fields.get("request_id"),
+               trace_id=trace_id)
+
+
+def record_serve_cause(cause: str, trace_id: Optional[str] = None,
+                       latency_ms: float = 0.0, **fields) -> None:
+    """Attribute a tail cause OUTSIDE the engine's completion path — the
+    Router calls this for ``failover`` (the engine never sees the dead
+    replica's request) — bumping the same per-cause counter/exemplar
+    ``record_serve_request`` feeds, plus a ``serve_cause`` event for the
+    merged trace."""
+    if not _state.enabled:
+        return
+    cause = str(cause)
+    with _state.lock:
+        sv = _state.serve
+        sv["causes"][cause] = sv["causes"].get(cause, 0) + 1
+        if trace_id:
+            sv["cause_exemplars"][cause] = {
+                "trace_id": str(trace_id),
+                "latency_ms": round(float(latency_ms), 3)}
+    record("serve_cause", cause=cause, trace_id=trace_id,
+           latency_ms=round(float(latency_ms), 3), **fields)
+
+
+def recent_requests() -> List[dict]:
+    """The newest completed serving requests (trace id, attributed cause,
+    latency — oldest first), bounded by ``MX_RQTRACE_TRACEZ_K``: the
+    per-rank half of the /tracez surface (metrics_server serves it;
+    the Router serves its own cross-replica view)."""
+    with _state.lock:
+        return [dict(r) for r in _state.serve_recent]
 
 
 def record_serve_state(queue_depth: int, active_slots: int,
@@ -941,6 +1022,9 @@ def _serving_rollup() -> dict:
                 sv.get("spec_accepted", 0)
                 / max(1, sv.get("spec_proposed", 0)), 4),
         },
+        "causes": dict(sv.get("causes", {})),
+        "cause_exemplars": {k: dict(v) for k, v in
+                            sv.get("cause_exemplars", {}).items()},
     }
 
 
@@ -1121,14 +1205,27 @@ def export_chrome_trace(directory: Optional[str] = None,
     (``s``/``t``/``f`` sharing an id per occurrence of each op), so the
     gang-wide shape of an allreduce is one connected arrow in the
     Perfetto UI.  Monotonic span stamps align to the shared wall timeline
-    via each rank's ``clock_anchor`` offset.  Returns None when no rank
-    stream exists."""
+    via each rank's ``clock_anchor`` offset.
+
+    Request tracing (docs/OBSERVABILITY.md §Request tracing): serving
+    spans whose args carry a ``trace_id`` and whose name is a
+    cross-process hop anchor (the Router's ``serve_dispatch``, the
+    replica's ``serve_handle``) are chained by per-trace flow events —
+    one connected arrow from the router's dispatch slice into the
+    replica's request tree, exactly like the collective flows but keyed
+    on the trace id instead of the occurrence index (two DIFFERENT
+    processes, not the same op on every rank).  Returns None when no
+    rank stream exists."""
     directory = directory or _state.dir
     if not directory:
         return None
     flush()  # this process's own stream must include the latest events
     trace: List[dict] = []
     coll_occurrence: Dict[Any, int] = {}  # op -> running flow id per rank
+    # trace_id -> [(ts_mid, pid, tid, stream_idx)] of its hop-anchor
+    # slices across ALL streams; becomes one flow chain per request
+    req_flow: Dict[str, List[tuple]] = {}
+    flow_anchors = ("serve_dispatch", "serve_handle")
     any_events = False
     for rank_id, path in _iter_rank_files(directory):
         events = _load_rank_events(path)
@@ -1187,6 +1284,11 @@ def export_chrome_trace(directory: Optional[str] = None,
                 trace.append({"ph": "E", "name": begin.get("name", "?"),
                               "pid": rank_id, "tid": tid,
                               "ts": max(ts1, ts0), "_sub": idx})
+                if begin.get("trace_id") and \
+                        begin.get("name") in flow_anchors:
+                    req_flow.setdefault(str(begin["trace_id"]), []).append(
+                        ((ts0 + max(ts1, ts0)) / 2.0, rank_id, tid,
+                         begin_idx))
             elif kind == "span" and "mono" in ev:
                 # complete form -> ph "X" (ts + dur).  These are written
                 # at EXIT, so their file order is child-before-parent; a
@@ -1195,13 +1297,16 @@ def export_chrome_trace(directory: Optional[str] = None,
                 # their extent and cannot be imbalanced; Perfetto nests
                 # them natively.
                 tid = tids.setdefault(ev.get("tid"), len(tids))
+                ts_x = (float(ev["mono"]) + offset) * 1e6
+                dur_x = max(float(ev.get("dur_ms", 0.0)) * 1e3, 0.001)
                 trace.append({"ph": "X", "name": ev.get("name", "?"),
                               "pid": rank_id, "tid": tid,
-                              "ts": (float(ev["mono"]) + offset) * 1e6,
-                              "dur": max(float(ev.get("dur_ms", 0.0))
-                                         * 1e3, 0.001),
+                              "ts": ts_x, "dur": dur_x,
                               "args": span_args(ev),
                               "_sub": idx})
+                if ev.get("trace_id") and ev.get("name") in flow_anchors:
+                    req_flow.setdefault(str(ev["trace_id"]), []).append(
+                        (ts_x + dur_x / 2.0, rank_id, tid, idx))
             elif kind == "mem":
                 # per-rank counter track: category bytes render as a
                 # stacked area series under the span timeline (Perfetto
@@ -1240,6 +1345,19 @@ def export_chrome_trace(directory: Optional[str] = None,
                 trace.append({"ph": ph, "cat": "collective", "name": op,
                               "id": flow_id, "pid": rank_id, "tid": tid,
                               "ts": ts + dur / 2, "bp": "e"})
+    # one flow chain per traced request: s on its earliest hop anchor
+    # (the router's dispatch slice), t on each later one (the replica's
+    # handle slice — two on a failover re-dispatch, still ONE chain)
+    for trace_key, pts in req_flow.items():
+        if len(pts) < 2:
+            continue  # a single-process trace has nothing to link
+        pts.sort()
+        flow_id = hash(("rqtrace", trace_key)) & 0x7FFFFFFF
+        for i, (ts_mid, pid_, tid_, sub) in enumerate(pts):
+            trace.append({"ph": "s" if i == 0 else "t", "cat": "request",
+                          "name": trace_key, "id": flow_id, "pid": pid_,
+                          "tid": tid_, "ts": ts_mid, "bp": "e",
+                          "_sub": sub})
     if not any_events:
         return None
     # chronological, with the _sub stream-index key breaking µs ts ties
@@ -1380,6 +1498,28 @@ def render_prometheus(mode: str = "live") -> str:
             gauge("mx_serve_spec_accepted_total", sp["accepted"],
                   kind="counter")
             gauge("mx_serve_spec_accept_rate", sp["accept_rate"])
+        # request-tracing cause attribution: per-cause counter + one
+        # exemplar-style gauge per cause carrying the NEWEST trace id as
+        # a label (bounded cardinality: one series per cause, the trace
+        # id label rewrites in place — the poor-man's OpenMetrics
+        # exemplar, since the text exposition has no native ones)
+        causes = sv.get("causes", {})
+        if causes:
+            lines.append("# TYPE mx_serve_request_cause_total counter")
+            for cause, n in sorted(causes.items()):
+                lines.append(
+                    f'mx_serve_request_cause_total{{{rank_lbl},'
+                    f'cause="{_prom_escape(cause)}"}} {n}')
+            ex = sv.get("cause_exemplars", {})
+            if ex:
+                lines.append(
+                    "# TYPE mx_serve_request_exemplar_latency_ms gauge")
+                for cause, row in sorted(ex.items()):
+                    lines.append(
+                        f'mx_serve_request_exemplar_latency_ms{{'
+                        f'{rank_lbl},cause="{_prom_escape(cause)}",'
+                        f'trace_id="{_prom_escape(row["trace_id"])}"}} '
+                        f'{row["latency_ms"]}')
     per_key("mx_span_total", s["spans"], "count", "span", kind="counter")
     per_key("mx_span_ms_total", s["spans"], "total_ms", "span",
             kind="counter")

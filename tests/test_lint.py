@@ -982,6 +982,32 @@ def test_router_handler_jax_use_flagged(tmp_path):
     assert findings == []
 
 
+def test_tracez_handler_jax_use_flagged(tmp_path):
+    """The router's /tracez handler (docs/OBSERVABILITY.md §Request
+    tracing) is reachable from ``_RouterHandler.do_GET`` — it must stay
+    a host-side rollup read: a jax touch on that path would block a
+    trace scrape on the device."""
+    jax_free = {"mxnet_tpu/fixture.py": ("_RouterHandler.do_GET",)}
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class _RouterHandler:
+            def do_GET(self):
+                return self._send(200, self.server.router.tracez())
+        """, jax_free=jax_free)
+    assert findings == []
+
+    findings, _ = _lint_jaxfree(tmp_path, """
+        class _RouterHandler:
+            def do_GET(self):
+                return self._send(200, self.server.router.tracez())
+
+            def _send(self, code, payload):
+                import jax
+
+                jax.block_until_ready(payload)
+        """, jax_free=jax_free)
+    assert "jax-in-handler" in rules_of(findings)
+
+
 def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
     (tmp_path / "mxnet_tpu").mkdir(parents=True)
     (tmp_path / "mxnet_tpu" / "broken.py").write_text("def f(:\n")

@@ -217,6 +217,53 @@ def _print_trace_report(tdir: str) -> None:
               "above", file=sys.stderr)
 
 
+def _serving_streams_present(tdir: str) -> bool:
+    """Whether any telemetry stream under ``tdir`` carries serving
+    events/spans (the ``serve_`` vocabulary).  Bounded scan — the
+    supervisor must not slurp multi-GB streams just to decide whether
+    to run serve_report."""
+    try:
+        names = sorted(os.listdir(tdir))
+    except OSError:
+        return False
+    for name in names:
+        if not (name.startswith("rank-") and name.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(tdir, name), "rb") as f:
+                if b'"serve_' in f.read(262_144):
+                    return True
+        except OSError:
+            continue
+    return False
+
+
+def _print_serve_report(tdir: str) -> None:
+    """Run tools/serve_report.py over the telemetry dir and echo the
+    per-request tail attribution — most importantly the UNFINISHED
+    request trees ("died inside X", fleet edition) — next to the flight
+    tails.  Subprocess + timeout for the same reason as
+    _print_trace_report: stdlib-only, must not wedge the supervisor."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_report.py")
+    if not os.path.isfile(script):
+        return
+    try:
+        res = subprocess.run([sys.executable, script, tdir],
+                             capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"launch.py: serve report failed: {e}", file=sys.stderr)
+        return
+    body = (res.stdout or "").strip()
+    if body:
+        print("launch.py: serving request report:", file=sys.stderr)
+        for line in body.splitlines():
+            print(f"  {line}", file=sys.stderr)
+    if res.returncode == 3:
+        print("launch.py: serve report flagged SLO violations (exit 3) "
+              "— see above", file=sys.stderr)
+
+
 def _reexport_trace(tdir) -> None:
     """Re-merge the gang Chrome trace after EVERY rank has been reaped.
 
@@ -704,6 +751,11 @@ class _HeartbeatMonitor:
                 _print_oom_report(oom, rank)
         if saw_events:
             _print_trace_report(self.dir)
+            if _serving_streams_present(self.dir):
+                # serving fleet post-mortem: the per-request view —
+                # which requests never finished and inside which span
+                # they died — is the serving analogue of the flight tail
+                _print_serve_report(self.dir)
 
 
 def _free_port() -> int:

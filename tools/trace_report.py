@@ -241,6 +241,18 @@ def _rank_stats(events: List[dict], window: int) -> dict:
         "run_span_ms": round(run_span_ms, 3),
         "accounted_ms": round(accounted_ms, 3),
         "idle_gap_ms": round(max(0.0, run_span_ms - accounted_ms), 3),
+        # serving-mode streams (router/replica processes) break both
+        # straggler rules' assumptions: the driver thread blocks in
+        # request polls while HTTP handler threads do the work, so the
+        # busiest-thread idle-gap math reads wait time as unaccounted
+        # skew, and there are no steady step walls at all.  Flagged
+        # here so build_report can exclude them and defer request-level
+        # analysis to tools/serve_report.py.
+        "serving_mode": any(
+            str(e.get("kind", "")).startswith("serve_")
+            or (e.get("kind") in ("span", "span_begin")
+                and str(e.get("name", "")).startswith("serve_"))
+            for e in events),
         "spans": {k: {"count": v["count"],
                       "total_ms": round(v["total_ms"], 3),
                       "max_ms": round(v["max_ms"], 3)}
@@ -459,6 +471,7 @@ def build_report(directory: str, window: Optional[int] = None,
     gap_sec = (gap_sec if gap_sec is not None
                else _env_float("MX_TRACE_HEARTBEAT_GAP_SEC", DEFAULT_GAP_SEC))
     ranks, warnings = load_gang(directory)
+    warnings = list(warnings)
     per_rank = {r: _rank_stats(events, window)
                 for r, events in ranks.items()}
     # gang-wide phase breakdown: where a steady step's time goes
@@ -473,7 +486,20 @@ def build_report(directory: str, window: Optional[int] = None,
         if cnt:
             phases[name] = {"count": cnt, "total_ms": round(tot, 3),
                             "mean_ms": round(tot / cnt, 3)}
-    stragglers = _find_stragglers(per_rank, pct)
+    # serving streams confuse both straggler rules (driver thread
+    # blocks while HTTP threads serve; no step cadence): exclude them
+    # from the skew math and point at serve_report, which reconstructs
+    # per-request trees instead of per-step walls
+    serving_ranks = sorted(r for r, s in per_rank.items()
+                           if s.get("serving_mode"))
+    stragglers = _find_stragglers(
+        {r: s for r, s in per_rank.items()
+         if not s.get("serving_mode")}, pct)
+    if serving_ranks:
+        warnings.append(
+            f"rank(s) {serving_ranks} are serving-mode streams — "
+            "excluded from straggler rules; run tools/serve_report.py "
+            "for request-level analysis")
     retraces = _retrace_table(ranks)
     gaps = _event_gaps(ranks, gap_sec)
     resizes = []
@@ -511,6 +537,7 @@ def build_report(directory: str, window: Optional[int] = None,
                                       for s in per_rank.values()), 3),
         "collectives": _collective_table(ranks),
         "serving": _serving_section(ranks),
+        "serving_ranks": serving_ranks,
         "retraces": retraces,
         "resizes": resizes,
         "event_gaps": gaps,
