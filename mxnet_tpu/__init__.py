@@ -40,6 +40,7 @@ from . import module as mod
 from . import gluon
 from . import parallel
 from . import precision
+from . import passes
 from . import io
 from . import image
 from . import callback

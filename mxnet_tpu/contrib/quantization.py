@@ -29,7 +29,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["quantize_net", "quantize_model", "calib_entropy_threshold",
-           "check_calibrated_threshold", "QuantizedDense", "QuantizedConv2D"]
+           "check_calibrated_threshold", "QuantizedDense", "QuantizedConv2D",
+           "Int4Dense", "Int4Conv2D"]
 
 
 def check_calibrated_threshold(path: str, calib_mode: str, minmax,
@@ -275,6 +276,135 @@ class QuantizedConv2D(_QuantizedLayerBase):
             num_group=k.get("num_group", 1),
             no_bias=self._no_bias)
         out = F.contrib.dequantize(acc, amn, amx)
+        return (F.Activation(out, act_type=self._act_type)
+                if self._act_type else out)
+
+
+# ---------------------------------------------------------------------------
+# int4 weight-only twins (serving; precision/quantize.py int4 path)
+# ---------------------------------------------------------------------------
+def _quantize_weight_int4_np(w: np.ndarray, group_size: int = 32):
+    """Pack a 2-D weight 2-per-byte with group-wise symmetric scales.
+
+    Groups of ``group_size`` run along the input dim (axis 1); the input
+    dim is zero-padded to a group multiple (padding quantizes to exact
+    zeros, sliced off again by ``_contrib_dequantize_int4``'s ``cols``).
+    Per group: thresh = max|w|, scale = thresh / 7, q = round(w / scale)
+    clipped to [-7, 7].  Two consecutive columns share a byte (low nibble
+    = even column).  Scales are f16 — 2 bytes per ``group_size`` weights,
+    so total bytes = 0.5 + 2/group_size per weight (0.5625 at g=32)
+    vs 4.0 for f32: the ~0.14x weight-bytes ratio.
+    """
+    if group_size < 2 or group_size % 2:
+        raise MXNetError(
+            f"int4 group_size must be even and >= 2, got {group_size}")
+    w = np.asarray(w, np.float32)
+    if w.ndim != 2:
+        raise MXNetError(
+            f"_quantize_weight_int4_np packs 2-D weights, got {w.shape}")
+    rows, cols = w.shape
+    pad = (-cols) % group_size
+    if pad:
+        w = np.pad(w, ((0, 0), (0, pad)))
+    g = w.reshape(rows, -1, group_size)
+    thresh = np.maximum(np.abs(g).max(axis=-1), 1e-12)
+    # f16 ROUND-TRIPPED before quantizing: the dequant side reads f16
+    # scales, so q must be computed against the value it will actually
+    # be multiplied by
+    scales = (thresh / 7.0).astype(np.float16)
+    q = np.clip(np.round(g / scales.astype(np.float32)[..., None]),
+                -7, 7).astype(np.int8).reshape(rows, -1)
+    lo = q[:, 0::2].astype(np.uint8) & 0x0F
+    hi = q[:, 1::2].astype(np.uint8) & 0x0F
+    packed = (lo | (hi << 4)).astype(np.uint8)
+    return packed, scales, cols
+
+
+class _Int4LayerBase:
+    """Weight-only int4 twin: the packed weight + f16 group scales live
+    as device constants of the traced graph; ``_forward`` dequantizes
+    IN-TRACE (``F.contrib.dequantize_int4``) and runs the stock f32
+    kernel.  No activation quantization, hence no calibration — the
+    weight-bytes footprint is the whole point (decode is weight-
+    bandwidth bound).  F-generic like the int8 twins: one copy of the
+    lowering for eager self-checks and the traced serving rewrite."""
+
+    def _dequant(self, F):
+        return F.contrib.dequantize_int4(
+            self._packed, self._scales, group_size=self._group,
+            cols=self._cols)
+
+    def __call__(self, x):
+        from .. import nd
+
+        return self._forward(nd, x, self._bias)
+
+
+class Int4Dense(_Int4LayerBase):
+    def __init__(self, dense, group_size: int = 32):
+        from .. import nd
+
+        w = dense.weight.data().asnumpy()
+        packed, scales, cols = _quantize_weight_int4_np(w, group_size)
+        self._packed = nd.array(packed, dtype=np.uint8)
+        self._scales = nd.array(scales, dtype=np.float16)
+        self._group = int(group_size)
+        self._cols = cols
+        self._units = dense._units
+        self._flatten = getattr(dense, "_flatten", True)
+        self._act_type = dense._act_type
+        self._no_bias = dense.bias is None
+        self._bias = (dense.bias.data() if dense.bias is not None
+                      else nd.zeros((dense._units,)))
+        self.orig_nbytes = int(w.nbytes)
+        self.nbytes = int(packed.nbytes) + int(scales.nbytes)
+
+    def _forward(self, F, x, bias):
+        w = self._dequant(F)
+        if self._no_bias:
+            out = F.FullyConnected(x, w, num_hidden=self._units,
+                                   no_bias=True, flatten=self._flatten)
+        else:
+            out = F.FullyConnected(x, w, bias, num_hidden=self._units,
+                                   no_bias=False, flatten=self._flatten)
+        return (F.Activation(out, act_type=self._act_type)
+                if self._act_type else out)
+
+
+class Int4Conv2D(_Int4LayerBase):
+    def __init__(self, conv, group_size: int = 32):
+        from .. import nd
+
+        w = conv.weight.data().asnumpy()
+        self._wshape = tuple(w.shape)
+        # pack the OIHW weight as (O, I*kh*kw); _forward reshapes the
+        # dequantized matrix back before the conv
+        packed, scales, cols = _quantize_weight_int4_np(
+            w.reshape(w.shape[0], -1), group_size)
+        self._packed = nd.array(packed, dtype=np.uint8)
+        self._scales = nd.array(scales, dtype=np.float16)
+        self._group = int(group_size)
+        self._cols = cols
+        self._kwargs = dict(conv._kwargs)
+        nf = int(self._kwargs["num_filter"])
+        self._no_bias = conv.bias is None
+        self._bias = (conv.bias.data() if conv.bias is not None
+                      else nd.zeros((nf,)))
+        self._act_type = conv._act_type
+        self.orig_nbytes = int(w.nbytes)
+        self.nbytes = int(packed.nbytes) + int(scales.nbytes)
+
+    def _forward(self, F, x, bias):
+        w = F.reshape(self._dequant(F), shape=self._wshape)
+        k = self._kwargs
+        kw = dict(kernel=k["kernel"], stride=k.get("stride", ()),
+                  dilate=k.get("dilate", ()), pad=k.get("pad", ()),
+                  num_filter=int(k["num_filter"]),
+                  num_group=k.get("num_group", 1))
+        if self._no_bias:
+            out = F.Convolution(x, w, no_bias=True, **kw)
+        else:
+            out = F.Convolution(x, w, bias, no_bias=False, **kw)
         return (F.Activation(out, act_type=self._act_type)
                 if self._act_type else out)
 

@@ -383,6 +383,34 @@ ENV_VARS: Dict[str, Tuple[str, str]] = {
         "min/max, default) or entropy (KL-optimal threshold over a "
         "streaming histogram) (precision/quantize.py; calibrators from "
         "contrib/quantization.py)"),
+    "MX_SERVE_INT4": (
+        "honored", "int4 (or 1) routes maybe_int4_adapter to build a "
+        "weight-only int4 serving adapter: Dense/Conv weights packed 2 "
+        "per byte with group-wise f16 scales, dequantized in-trace "
+        "inside the engine's compiled decode/prefill bodies — ~0.14x "
+        "weight bytes, no calibration; rejected if MX_QUANTIZE is also "
+        "set (precision/quantize.py)"),
+    "MX_QUANT_GROUP": (
+        "honored", "group size for MX_SERVE_INT4's group-wise int4 "
+        "scales (default 32, must be even): one f16 scale per group of "
+        "weights along the input dim — smaller groups trade bytes for "
+        "accuracy (contrib/quantization._quantize_weight_int4_np)"),
+    # pass pipeline (docs/PRECISION.md §Pass pipeline; passes/)
+    "MX_PASSES": (
+        "honored", "comma-separated per-pass toggles applied to every "
+        "constructed pass pipeline: 'name' asserts the pass type is "
+        "registered, '-name' disables that pass where present (the "
+        "disabled pass contributes nothing to the trace or the pipeline "
+        "fingerprint — bitwise the pass-less program); unknown names "
+        "raise listing the registered set (passes/pipeline.py "
+        "apply_env_toggles)"),
+    "MX_PALLAS_FUSED": (
+        "honored", "fused-kernel substitution pass (ops/pallas/"
+        "registry.py): auto (default) substitutes registered Pallas "
+        "kernels for their op-class only where they compile natively "
+        "(TPU, MXNET_USE_FUSION on); 1 forces the pass (interpret-mode "
+        "kernels — the CPU test path); 0 pins the stock op "
+        "implementations (passes/builtin.fused_kernels_from_env)"),
     # memory & compile observability (docs/OBSERVABILITY.md §Memory)
     "MX_MEMWATCH": (
         "honored", "device-memory watchdog riding the telemetry "

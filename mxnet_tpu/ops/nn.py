@@ -471,6 +471,24 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     return out
 
 
+@register("_contrib_add_layer_norm")
+def add_layer_norm(data, residual, gamma, beta, eps=1e-5):
+    """Residual add + last-axis layer norm: LN(data + residual).  The
+    pre-norm transformer block boundary as ONE op-class, so the
+    fused_kernels pass can substitute the single-VMEM-pass Pallas kernel
+    (ops/pallas/fused.add_layer_norm); this stock implementation is the
+    bitwise-parity path when the pass is off."""
+    x32 = data.astype(jnp.float32) + residual.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    out_dtype = jnp.result_type(data.dtype, residual.dtype)
+    shape = [1] * data.ndim
+    shape[-1] = data.shape[-1]
+    return ((x32 - mean) * inv).astype(out_dtype) * gamma.reshape(
+        shape) + beta.reshape(shape)
+
+
 @register("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3):
     red = tuple(range(2, data.ndim))

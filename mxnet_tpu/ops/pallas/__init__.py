@@ -16,7 +16,7 @@ All kernels run in interpret mode on CPU (so the test suite exercises them
 on the 8-device virtual mesh) and compile through Mosaic on TPU.
 """
 from .flash_attention import flash_attention
-from .fused import layer_norm, softmax_cross_entropy
+from .fused import add_layer_norm, layer_norm, softmax_cross_entropy
 from .paged_attention import paged_decode_attention
 
 import os
@@ -67,5 +67,9 @@ def compute_on(platform: str):
 
 
 __all__ = ["flash_attention", "softmax_cross_entropy", "layer_norm",
-           "paged_decode_attention", "enabled", "use_compiled",
-           "compute_on"]
+           "add_layer_norm", "paged_decode_attention", "enabled",
+           "use_compiled", "compute_on", "registry"]
+
+# the fused-kernel registry (op-class -> Pallas kernel, per platform);
+# imported last: its catalog references the kernels above
+from . import registry  # noqa: E402

@@ -158,3 +158,77 @@ def _ln_bwd(eps, res, g):
 
 
 layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused residual-add + layer norm
+# ---------------------------------------------------------------------------
+def _aln_kernel(eps, x_ref, r_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref):
+    x = (x_ref[...].astype(jnp.float32)
+         + r_ref[...].astype(jnp.float32))     # (bn, C): the fused add
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o = xc * rstd * g_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    o_ref[...] = o.astype(o_ref.dtype)
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
+
+
+def _aln_fwd_impl(x, res, gamma, beta, eps):
+    n, c = x.shape
+    bn = min(256, _round_up(n, 8))
+    n_p = _round_up(n, bn)
+    xp = jnp.pad(x, ((0, n_p - n), (0, 0)))
+    rp = jnp.pad(res, ((0, n_p - n), (0, 0)))
+    out, mu, rstd = pl.pallas_call(
+        functools.partial(_aln_kernel, eps),
+        grid=(n_p // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                   pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_p, c), x.dtype),
+                   jax.ShapeDtypeStruct((n_p, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n_p, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(xp, rp, gamma[None, :], beta[None, :])
+    return out[:n], mu[:n], rstd[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def add_layer_norm(x, res, gamma, beta, eps=1e-5):
+    """Fused residual add + row-wise layer norm: LN(x + res) in ONE VMEM
+    pass — the pre-norm transformer block boundary never materialises
+    the sum.  x/res (N, C), gamma/beta (C,)."""
+    out, _, _ = _aln_fwd_impl(x, res, gamma, beta, eps)
+    return out
+
+
+def _aln_fwd(x, res, gamma, beta, eps):
+    out, mu, rstd = _aln_fwd_impl(x, res, gamma, beta, eps)
+    return out, (x, res, gamma, mu, rstd)
+
+
+def _aln_bwd(eps, resids, g):
+    x, res, gamma, mu, rstd = resids
+    s = x.astype(jnp.float32) + res.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    xhat = (s - mu) * rstd
+    dgamma = (gf * xhat).sum(axis=0)
+    dbeta = gf.sum(axis=0)
+    dxhat = gf * gamma.astype(jnp.float32)[None, :]
+    c = x.shape[-1]
+    ds = rstd / c * (c * dxhat - dxhat.sum(-1, keepdims=True)
+                     - xhat * (dxhat * xhat).sum(-1, keepdims=True))
+    # the add fans the cotangent out to BOTH branches unchanged
+    return (ds.astype(x.dtype), ds.astype(res.dtype),
+            dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+
+
+add_layer_norm.defvjp(_aln_fwd, _aln_bwd)

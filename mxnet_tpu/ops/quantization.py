@@ -78,6 +78,38 @@ def _int32_range(t_data, t_weight):
     return -t, t
 
 
+@register("_contrib_dequantize_int4", differentiable=False)
+def dequantize_int4(packed, scales, group_size=32, cols=0):
+    """Unpack 2-per-byte int4 weights and apply group-wise scales.
+
+    ``packed`` is uint8 (rows, padded_cols // 2): each byte carries two
+    signed nibbles along the input dim (low nibble = even column, the
+    ``_quantize_weight_int4_np`` layout).  ``scales`` is f16/f32
+    (rows, padded_cols // group_size) of per-group dequant scales
+    (thresh / 7).  Returns the f32 weight (rows, cols) — ``cols`` slices
+    off the zero padding the packer added to reach a group multiple.
+
+    This runs IN-TRACE inside the serving engine's compiled decode/
+    prefill bodies (precision/quantize.py int4 path): the executable's
+    resident weight is the packed buffer, and XLA fuses the unpack +
+    scale into the consumer matmul's operand read — the decode-bandwidth
+    win weight-only int4 serving is for.
+    """
+    b = packed
+    lo = jnp.bitwise_and(b, jnp.uint8(0x0F)).astype(jnp.int32)
+    hi = jnp.right_shift(b, jnp.uint8(4)).astype(jnp.int32)
+    # nibbles are two's-complement in [-8, 7] (quantized range [-7, 7])
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    rows = b.shape[0]
+    q = jnp.stack([lo, hi], axis=-1).reshape(rows, -1)  # interleave
+    g = int(group_size)
+    w = (q.astype(jnp.float32).reshape(rows, -1, g)
+         * scales.astype(jnp.float32)[..., None]).reshape(rows, -1)
+    c = int(cols)
+    return w[:, :c] if c and c != w.shape[1] else w
+
+
 @register("_contrib_quantized_fully_connected", differentiable=False)
 def quantized_fully_connected(data, weight, bias, min_data, max_data,
                               min_weight, max_weight, min_bias=None,

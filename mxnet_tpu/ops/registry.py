@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..base import MXNetError, canonical_kwargs
 from .. import engine
-from ..precision import runtime as _precision
+from ..passes import hooks as _pass_hooks
 
 __all__ = ["Operator", "register", "get_op", "invoke", "list_ops"]
 
@@ -155,13 +155,18 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
     from ..ndarray import NDArray
     from .. import autograd
 
-    if _precision._AMP_POLICY is not None and inputs:
-        # graph-level AMP pass (docs/PRECISION.md): inside an active
-        # amp_scope — i.e. during the one trace DataParallelStep._build
-        # runs — low-class ops take policy-dtype inputs, widen-class ops
-        # take f32.  The module-global None check above is the entire
-        # AMP-off cost: the default dispatch path is unchanged.
-        inputs = _precision.cast_inputs(op.name, inputs)
+    # THE pass-pipeline consultation (docs/PRECISION.md §Pass pipeline):
+    # the ONE module global dispatch reads.  Empty tuple when no pass is
+    # active — that falsy check is the entire passes-off cost, exactly
+    # the contract the PR 15 AMP global established.  Active hooks (the
+    # AMP cast pass, ...) rewrite this call's inputs; trace-time kernel
+    # substitution consults the same tuple on the traced branch below.
+    # mxlint pins this: any OTHER module-global consultation added here
+    # is a pass-outside-pipeline finding.
+    op_hooks = _pass_hooks._OP_HOOKS
+    if op_hooks and inputs:
+        for h in op_hooks:
+            inputs = h.rewrite_inputs(op.name, inputs)
     arrays = [x._data for x in inputs]
     if inputs:
         ctx = inputs[0].context
@@ -178,7 +183,17 @@ def _invoke_impl(op: Operator, inputs: Sequence, out=None, ctx=None, **attrs):
 
         _params.validate_known(op.name, attrs)
         arrays = _stop_detached(arrays, inputs)
-        outs = op.fn(*arrays, **attrs)
+        fn = op.fn
+        if op_hooks:
+            # fused-kernel substitution (passes/builtin.FusedKernelPass):
+            # inside a trace an active pass may swap this op-class's
+            # FCompute for a registered Pallas kernel; eager dispatch
+            # never consults the kernel registry
+            for h in op_hooks:
+                alt = h.substitute(op.name, attrs)
+                if alt is not None:
+                    fn = alt
+        outs = fn(*arrays, **attrs)
     elif not arrays:
         # creation op: place the result on ctx's device
         import jax

@@ -465,6 +465,13 @@ class DataParallelStep:
         self._precision = plan.precision
         self._loss_scale_cfg = (plan.precision.loss_scale
                                 if plan.precision is not None else None)
+        # the training pass pipeline (passes/builtin): the Plan's AMP
+        # policy + fused-kernel substitution (MX_PALLAS_FUSED), subject
+        # to MX_PASSES toggles.  _build wraps the block apply with it,
+        # and its ONE signature joins the executable fingerprint below.
+        from ..passes.builtin import pipeline_for_training
+
+        self._pipeline = pipeline_for_training(plan.precision)
         self.mesh = mesh
         self.block = block
         self.loss_fn = loss_fn
@@ -626,14 +633,14 @@ class DataParallelStep:
             def apply_fn(params, key, *xs):
                 out, vals = ck(params, key, *xs)
                 return out, list(zip(names_cell[0], vals))
-        if self._precision is not None and self._precision.amp is not None:
-            # graph-level AMP pass (docs/PRECISION.md): the policy scope
-            # is active during THIS trace only, so the whole
-            # mixed-precision program lands in the one compiled
-            # executable; block outputs widen to f32 at the boundary
-            from ..precision.amp_pass import apply_amp
-
-            apply_fn = apply_amp(apply_fn, self._precision.amp)
+        # the pass pipeline wraps the block apply (docs/PRECISION.md
+        # §Pass pipeline): AMP's policy scope is active during THIS
+        # trace only, so the whole mixed-precision program lands in the
+        # one compiled executable (outputs widen to f32 at the
+        # boundary); fused-kernel substitution swaps Pallas kernels at
+        # the dispatch point.  An empty pipeline returns apply_fn
+        # itself — the bitwise pre-pipeline program.
+        apply_fn = self._pipeline.wrap_apply(apply_fn)
         loss_fn = self.loss_fn
         opt = self._optimizer
         momentum, wd, rescale = self._momentum, self._wd, self._rescale
@@ -1092,7 +1099,11 @@ class DataParallelStep:
                      # MX_LOSS_SCALE must MISS the AOT cache, not load
                      # the other precision's program
                      self._precision.signature()
-                     if self._precision is not None else None)
+                     if self._precision is not None else None,
+                     # the ONE pass-pipeline signature: any config or
+                     # order change (pass toggled, fused set grown, AMP
+                     # policy swapped) changes the fingerprint
+                     self._pipeline.signature())
         return (("DataParallelStep",) + tuple(variant)
                 + (type(self.block).__name__,
                    self._optimizer, self.plan.accum_steps, hyper_sig,
@@ -1610,6 +1621,11 @@ class DataParallelStep:
             # Plan.from_json(layout["plan"]) rebuilds it on the new world
             # (docs/FAULT_TOLERANCE.md §Elastic resize)
             "plan": self.plan.to_json(),
+            # the pass-pipeline config rides with the layout too: a
+            # restore can rebuild descriptor passes
+            # (passes.PassPipeline.from_json) and compare fingerprints
+            # against the env it restarts under
+            "passes": self._pipeline.to_json(),
         }
 
     def _to_host_full(self, arr, allow_collective: bool = True):
@@ -1972,5 +1988,11 @@ def compile_step_with_plan(block, loss_fn, plan: Plan, mesh=None,
         telemetry.record(
             "plan", executor=step._tele_name, strategy=plan.strategy,
             plan=plan.to_json(),
+            # the pass pipeline this step compiles under: names + the
+            # shared fingerprint that keys its AOT executables — a trace
+            # reader can tie a slow/fast step stream to the exact
+            # rewrite config that produced it
+            passes=step._pipeline.names(),
+            pass_fingerprint=step._pipeline.fingerprint(),
             predicted=plan.predicted)
     return step

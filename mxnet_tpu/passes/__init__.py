@@ -1,0 +1,28 @@
+"""Graph pass pipeline (docs/PRECISION.md §Pass pipeline).
+
+A Relay-style pass manager (arXiv:1810.00952) over the repo's single op
+dispatch point: named, composable, individually-toggleable
+:class:`GraphPass` objects in an ordered :class:`PassPipeline` whose ONE
+shared ``signature()`` feeds every executable fingerprint (training
+hyper_sig, serving engine, the ``plan`` telemetry event).  The dispatch
+hook (``hooks._OP_HOOKS``) is the only module global
+``ops/registry._invoke_impl`` consults — pinned by mxlint's
+``pass-outside-pipeline`` rule.
+
+Env surface (env_vars.py): MX_PASSES (toggles), MX_PALLAS_FUSED
+(fused-kernel pass), MX_SERVE_INT4 + MX_QUANT_GROUP (int4 pass, via
+precision/quantize.py).
+"""
+from . import hooks
+from .pipeline import (GraphPass, PassPipeline, apply_env_toggles,
+                       available_passes, register_pass_type,
+                       resolve_pass_type)
+from .builtin import (AmpPass, FusedKernelPass, QuantizeInt4Pass,
+                      QuantizeInt8Pass, fused_kernels_from_env,
+                      pipeline_for_serving, pipeline_for_training)
+
+__all__ = ["GraphPass", "PassPipeline", "register_pass_type",
+           "available_passes", "resolve_pass_type", "apply_env_toggles",
+           "AmpPass", "QuantizeInt8Pass", "QuantizeInt4Pass",
+           "FusedKernelPass", "fused_kernels_from_env",
+           "pipeline_for_training", "pipeline_for_serving", "hooks"]

@@ -1,0 +1,57 @@
+"""The ONE dispatch consultation point of the pass pipeline.
+
+``ops/registry._invoke_impl`` reads exactly one module global —
+``_OP_HOOKS`` — per op call.  When no pass is active the tuple is empty
+and dispatch pays a single falsy check, byte-for-byte the pre-pipeline
+cost (the contract the PR 15 AMP global established, now owned here for
+every pass).  mxlint's ``pass-outside-pipeline`` rule pins this: any
+OTHER module-global consultation added to ``_invoke_impl`` is a finding.
+
+Active passes appear as hook objects implementing the two rewrite verbs
+the dispatch point offers:
+
+  * ``rewrite_inputs(op_name, inputs) -> inputs`` — edit one op call's
+    NDArray inputs before dispatch (the AMP cast pass);
+  * ``substitute(op_name, attrs) -> fn | None`` — swap the op's FCompute
+    for an alternative implementation inside a trace (the fused-kernel
+    pass); only consulted on the traced branch, so eager dispatch never
+    pays a registry lookup.
+
+This module is import-spine-safe: stdlib only, no jax/numpy.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["OpHook", "op_hook", "active"]
+
+_OP_HOOKS = ()   # tuple of active OpHook objects, innermost scope LAST
+
+
+class OpHook:
+    """Protocol/default base for a dispatch hook: both verbs are no-ops
+    so a pass overrides only the one it needs."""
+
+    def rewrite_inputs(self, op_name, inputs):
+        return inputs
+
+    def substitute(self, op_name, attrs):
+        return None
+
+
+def active() -> bool:
+    return bool(_OP_HOOKS)
+
+
+@contextlib.contextmanager
+def op_hook(hook):
+    """Push ``hook`` for the ops dispatched inside the block.  Hooks
+    nest and restore exactly like the precision scopes they generalize;
+    trace-time state, set by one thread around one trace."""
+    global _OP_HOOKS
+    prev = _OP_HOOKS
+    _OP_HOOKS = prev + (hook,)
+    try:
+        yield
+    finally:
+        _OP_HOOKS = prev

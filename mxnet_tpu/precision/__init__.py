@@ -19,7 +19,8 @@ Three pillars over the compiled train/serve paths:
     decode executable, AOT-fingerprinted by the quant config.
 
 Env surface (env_vars.py): MX_AMP, MX_AMP_POLICY, MX_LOSS_SCALE,
-MX_QUANTIZE, MX_QUANT_CALIB.
+MX_QUANTIZE, MX_QUANT_CALIB, MX_SERVE_INT4, MX_QUANT_GROUP (all the
+quant/AMP rewrites are registered graph passes — see ``passes/``).
 """
 from .config import (AmpPolicy, LossScaleConfig, PrecisionConfig,
                      DEFAULT_LOW_OPS, DEFAULT_WIDEN_OPS)
@@ -27,10 +28,12 @@ from .amp_pass import apply_amp
 from .runtime import amp_scope, quant_scope, quant_entry
 from . import loss_scale
 from .quantize import (QuantizedAdapter, quantize_adapter,
-                       maybe_quantize_adapter)
+                       maybe_quantize_adapter, Int4WeightAdapter,
+                       int4_adapter, maybe_int4_adapter)
 
 __all__ = ["AmpPolicy", "LossScaleConfig", "PrecisionConfig",
            "DEFAULT_LOW_OPS", "DEFAULT_WIDEN_OPS", "apply_amp",
            "amp_scope", "quant_scope", "quant_entry", "loss_scale",
            "QuantizedAdapter", "quantize_adapter",
-           "maybe_quantize_adapter"]
+           "maybe_quantize_adapter", "Int4WeightAdapter",
+           "int4_adapter", "maybe_int4_adapter"]

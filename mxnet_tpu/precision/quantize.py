@@ -31,19 +31,33 @@ fingerprint: a restart under different ``MX_QUANTIZE``/``MX_QUANT_CALIB``
 settings *misses* instead of deserializing the wrong program.  Int8
 buffers register under the ``quantized`` memwatch census category.
 
-Env surface: ``MX_QUANTIZE`` (``int8`` to enable, ``0``/unset off) and
-``MX_QUANT_CALIB`` (``naive``/``entropy``, default naive) drive
-:func:`maybe_quantize_adapter`.
+The int4 path (:class:`Int4WeightAdapter`) lives next to int8: weight-
+ONLY quantization — Dense/Conv weights packed 2 per byte with group-wise
+f16 scales (``MX_QUANT_GROUP``), dequantized IN-TRACE by
+``_contrib_dequantize_int4`` inside the engine's compiled decode/prefill
+bodies.  No activation quantization, hence no calibration: decode is
+weight-bandwidth bound, and ~0.14x weight bytes is the win.
+
+Both adapters express their rewrite as a registered graph pass
+(``passes/builtin``: ``quant_int8`` / ``quant_int4``) exposed via
+``.passes`` — the serving engine builds its pipeline from that, and the
+pass signature is what joins the AOT-cache fingerprint.
+
+Env surface: ``MX_QUANTIZE`` (``int8`` to enable, ``0``/unset off) with
+``MX_QUANT_CALIB`` (``naive``/``entropy``, default naive) drives
+:func:`maybe_quantize_adapter`; ``MX_SERVE_INT4`` (``1``/``int4`` on)
+with ``MX_QUANT_GROUP`` (group size, default 32, even) drives
+:func:`maybe_int4_adapter`.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from ..base import MXNetError
-from . import runtime
 
 
 def _calib_tools():
@@ -56,6 +70,7 @@ def _calib_tools():
     return cq
 
 __all__ = ["QuantizedAdapter", "quantize_adapter", "maybe_quantize_adapter",
+           "Int4WeightAdapter", "int4_adapter", "maybe_int4_adapter",
            "collect_quantizable", "calibrate"]
 
 
@@ -168,41 +183,188 @@ class _TracedTwin:
             F, x, bias if bias is not None else self._impl._bias)
 
 
+class _Int4Twin:
+    """Traced int4 twin of one Dense/Conv2D: wraps the weight-only
+    contrib impl (``Int4Dense``/``Int4Conv2D`` — the one copy of the
+    dequantize-in-trace lowering) with the layer path, a content digest
+    of the packed buffers (the restart-stable signature component — no
+    thresholds exist on a weight-only path), and byte accounting."""
+
+    def __init__(self, impl, path: str):
+        self._impl = impl
+        self.path = path
+        h = hashlib.sha256()
+        h.update(impl._packed.asnumpy().tobytes())
+        h.update(impl._scales.asnumpy().tobytes())
+        self.digest = h.hexdigest()[:16]
+        self.orig_nbytes = impl.orig_nbytes
+        self.nbytes = impl.nbytes
+
+    def arrays(self):
+        i = self._impl
+        return [i._packed._data, i._scales._data]
+
+    def __call__(self, F, x, bias):
+        return self._impl._forward(
+            F, x, bias if bias is not None else self._impl._bias)
+
+
 def _quantized_arrays(adapter):
-    """memwatch provider: the int8 weight buffers + range constants the
-    quantized adapter holds resident (the `quantized` census slice)."""
+    """memwatch provider: the quantized weight buffers + scale/range
+    constants the adapter holds resident (the `quantized` census
+    slice — int8 and int4 adapters both land here)."""
     out = []
     for entry in adapter._entries.values():
         out.extend(entry.arrays())
     return out
 
 
-class QuantizedAdapter:
+class _RewriteAdapterBase:
+    """Shared shell of the quantized serving adapters: mirror the
+    cached-decode interface facts, register the memwatch census, and
+    delegate the traced bodies under the adapter's graph pass scope
+    (``self._pass`` — a ``passes/builtin`` quant pass whose scope is
+    the ``runtime.quant_scope`` mapping activation).  Subclasses build
+    ``self._inner``, ``self._entries``/``self._by_path`` and
+    ``self._pass``, then call ``_init_common``."""
+
+    def _init_common(self, inner):
+        from .. import memwatch
+
+        self.uses_pages = inner.uses_pages
+        self.num_layers = inner.num_layers
+        self.num_heads = inner.num_heads
+        self.head_dim = inner.head_dim
+        self.prefill_names = inner.prefill_names
+        # the engine builds its pass pipeline from this
+        # (passes.pipeline_for_serving reads adapter.passes)
+        self.passes = (self._pass,)
+        memwatch.register("quantized", self, _quantized_arrays)
+
+    @staticmethod
+    def _resolve_model(inner, who: str):
+        model = getattr(inner, "model", None)
+        if model is None:
+            raise MXNetError(
+                f"{who}: the wrapped adapter exposes no .model to "
+                "quantize (FullPrefixAdapter-style logits functions own "
+                "no layer tree — quantize the underlying block and wrap "
+                "that)")
+        return model
+
+    # -- identity ------------------------------------------------------
+    @property
+    def model(self):
+        return self._inner.model
+
+    def quant_signature(self) -> Tuple:
+        """Structural identity of the quantization config — the pass's
+        signature.  A restart under different MX_QUANTIZE/MX_SERVE_INT4/
+        MX_QUANT_* settings (or requantized weights) produces a
+        different signature — the AOT cache then misses instead of
+        loading the wrong program."""
+        return self._pass.signature()
+
+    def signature(self):
+        return tuple(self._inner.signature()) + self.quant_signature()
+
+    # -- params accounting (the bench's params-bytes story) ------------
+    def quantized_param_bytes(self) -> int:
+        """Bytes of the weights as the quantized graph holds them:
+        packed/int8 for the rewritten layers' weights, original dtype
+        for everything else (biases, norms, embeddings, excluded
+        layers).  This is the PROGRAM's weight footprint
+        (docs/PRECISION.md §Params-bytes accounting), not process
+        residency — while the fp32 source net is alive the process
+        holds both it and the quantized twins."""
+        rewritten = {id(layer.weight)
+                     for _path, layer in collect_quantizable(self.model)
+                     if id(layer) in self._entries}
+        total = sum(e.nbytes for e in self._entries.values())
+        for p in self.model.collect_params().values():
+            if id(p) not in rewritten:
+                total += int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+        return total
+
+    def fp32_param_bytes(self) -> int:
+        return sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+                   for p in self.model.collect_params().values())
+
+    def quantized_weight_bytes(self) -> int:
+        """Bytes of JUST the rewritten layers' weights as the quantized
+        graph holds them (packed nibbles + scales for int4; int8 + range
+        scalars' weight part for int8).  The per-layer compression
+        acceptance ratio — whole-model ``quantized_param_bytes`` is
+        diluted by f32 embeddings/norms that no weight rewrite touches."""
+        return sum(e.nbytes for e in self._entries.values())
+
+    def fp32_weight_bytes(self) -> int:
+        """Original bytes of just the rewritten layers' weights."""
+        return sum(e.orig_nbytes for e in self._entries.values())
+
+    # -- delegated interface -------------------------------------------
+    def extra_state(self, slots, ctx, dtype):
+        return self._inner.extra_state(slots, ctx, dtype)
+
+    def prefill_src(self, request):
+        return self._inner.prefill_src(request)
+
+    def prefill(self, F, src):
+        with self._pass.scope():
+            return self._inner.prefill(F, src)
+
+    def install(self, state, slot, request):
+        return self._inner.install(state, slot, request)
+
+    def validate(self, request):
+        return self._inner.validate(request)
+
+    def max_positions(self):
+        return self._inner.max_positions()
+
+    def warmup(self, ctx):
+        # eager f32 warmup: shape inference only — the quantized graph
+        # appears at trace time, under the scope in decode/prefill
+        return self._inner.warmup(ctx)
+
+    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
+               extra, pools):
+        with self._pass.scope():
+            return self._inner.decode(F, tok, pos, table, keep, pages,
+                                      rows, lengths, extra, pools)
+
+    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
+                      lengths, extra, pools):
+        with self._pass.scope():
+            return self._inner.decode_logits(F, tok, pos, table, keep,
+                                             pages, rows, lengths, extra,
+                                             pools)
+
+    def advance_extra(self, F, extra, nxt, pos):
+        with self._pass.scope():
+            return self._inner.advance_extra(F, extra, nxt, pos)
+
+
+class QuantizedAdapter(_RewriteAdapterBase):
     """Int8 twin of any :class:`~mxnet_tpu.serving.engine.ServingAdapter`.
 
     Same cached-decode interface; ``decode``/``prefill`` run the wrapped
-    adapter's traced bodies under :func:`runtime.quant_scope`, so the
-    selected Dense/Conv layers lower onto the int8 primitives inside the
-    engine's ONE compiled executable.  Construct via
-    :func:`quantize_adapter` (calibrated) — this constructor takes
-    pre-computed thresholds."""
+    adapter's traced bodies under the ``quant_int8`` pass's scope
+    (:func:`runtime.quant_scope`), so the selected Dense/Conv layers
+    lower onto the int8 primitives inside the engine's ONE compiled
+    executable.  Construct via :func:`quantize_adapter` (calibrated) —
+    this constructor takes pre-computed thresholds."""
 
     precision = "int8"
 
     def __init__(self, inner, thresholds: Dict[str, Optional[float]],
                  calib_mode: str = "naive",
                  exclude: Iterable[str] = ()):
-        from .. import memwatch
         from ..gluon import nn as gnn
+        from ..passes.builtin import QuantizeInt8Pass
 
         cq = _calib_tools()
-        model = getattr(inner, "model", None)
-        if model is None:
-            raise MXNetError(
-                "QuantizedAdapter: the wrapped adapter exposes no .model "
-                "to quantize (FullPrefixAdapter-style logits functions "
-                "own no layer tree — quantize the underlying block and "
-                "wrap that)")
+        model = self._resolve_model(inner, "QuantizedAdapter")
         self._inner = inner
         self._calib_mode = calib_mode
         self._entries: Dict[int, object] = {}
@@ -223,97 +385,51 @@ class QuantizedAdapter:
             raise MXNetError(
                 "QuantizedAdapter: no quantizable Dense/Conv2D layers "
                 "found in the wrapped adapter's model")
-        # mirror the cached-decode interface facts the engine reads at
-        # construction time
-        self.uses_pages = inner.uses_pages
-        self.num_layers = inner.num_layers
-        self.num_heads = inner.num_heads
-        self.head_dim = inner.head_dim
-        self.prefill_names = inner.prefill_names
-        memwatch.register("quantized", self, _quantized_arrays)
-
-    # -- identity ------------------------------------------------------
-    @property
-    def model(self):
-        return self._inner.model
-
-    def quant_signature(self) -> Tuple:
-        """Structural identity of the quantization config: calib mode,
-        per-layer activation thresholds AND weight thresholds.  A
-        restart under different MX_QUANTIZE/MX_QUANT_CALIB settings (or
-        recalibrated scales) produces a different signature — the AOT
-        cache then misses instead of loading the wrong program."""
         per_layer = tuple(sorted(
             (path, round(e._w_thresh, 8),
              round(e.act_thresh, 8) if e.act_thresh is not None else None)
             for path, e in self._by_path.items()))
-        return ("int8", self._calib_mode, per_layer)
+        self._pass = QuantizeInt8Pass(self._entries, calib_mode, per_layer)
+        self._init_common(inner)
 
-    def signature(self):
-        return tuple(self._inner.signature()) + self.quant_signature()
 
-    # -- params accounting (the bench's params-bytes story) ------------
-    def quantized_param_bytes(self) -> int:
-        """Bytes of the weights as the quantized graph holds them: int8
-        for the rewritten layers' weights, original dtype for everything
-        else (biases, norms, embeddings, excluded layers).  This is the
-        PROGRAM's weight footprint (docs/PRECISION.md §Params-bytes
-        accounting), not process residency — while the fp32 source net
-        is alive the process holds both it and the int8 twins."""
-        rewritten = {id(layer.weight)
-                     for _path, layer in collect_quantizable(self.model)
-                     if id(layer) in self._entries}
-        total = sum(e.nbytes for e in self._entries.values())
-        for p in self.model.collect_params().values():
-            if id(p) not in rewritten:
-                total += int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
-        return total
+class Int4WeightAdapter(_RewriteAdapterBase):
+    """Weight-only int4 twin of a ServingAdapter: every selected
+    Dense/Conv weight is packed 2-per-byte with group-wise f16 scales
+    and dequantized IN-TRACE (``_contrib_dequantize_int4``) inside the
+    engine's compiled decode/prefill bodies — ~0.14x weight bytes at the
+    default group of 32, no calibration (activations stay f32).
+    Construct via :func:`int4_adapter` / :func:`maybe_int4_adapter`."""
 
-    def fp32_param_bytes(self) -> int:
-        return sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
-                   for p in self.model.collect_params().values())
+    precision = "int4"
 
-    # -- delegated interface -------------------------------------------
-    def extra_state(self, slots, ctx, dtype):
-        return self._inner.extra_state(slots, ctx, dtype)
+    def __init__(self, inner, group_size: int = 32,
+                 exclude: Iterable[str] = ()):
+        from ..gluon import nn as gnn
+        from ..passes.builtin import QuantizeInt4Pass
 
-    def prefill_src(self, request):
-        return self._inner.prefill_src(request)
-
-    def prefill(self, F, src):
-        with runtime.quant_scope(self._entries):
-            return self._inner.prefill(F, src)
-
-    def install(self, state, slot, request):
-        return self._inner.install(state, slot, request)
-
-    def validate(self, request):
-        return self._inner.validate(request)
-
-    def max_positions(self):
-        return self._inner.max_positions()
-
-    def warmup(self, ctx):
-        # eager f32 warmup: shape inference only — the quantized graph
-        # appears at trace time, under the scope in decode/prefill
-        return self._inner.warmup(ctx)
-
-    def decode(self, F, tok, pos, table, keep, pages, rows, lengths,
-               extra, pools):
-        with runtime.quant_scope(self._entries):
-            return self._inner.decode(F, tok, pos, table, keep, pages,
-                                      rows, lengths, extra, pools)
-
-    def decode_logits(self, F, tok, pos, table, keep, pages, rows,
-                      lengths, extra, pools):
-        with runtime.quant_scope(self._entries):
-            return self._inner.decode_logits(F, tok, pos, table, keep,
-                                             pages, rows, lengths, extra,
-                                             pools)
-
-    def advance_extra(self, F, extra, nxt, pos):
-        with runtime.quant_scope(self._entries):
-            return self._inner.advance_extra(F, extra, nxt, pos)
+        cq = _calib_tools()
+        model = self._resolve_model(inner, "Int4WeightAdapter")
+        self._inner = inner
+        self._group_size = int(group_size)
+        self._entries: Dict[int, object] = {}
+        self._by_path: Dict[str, object] = {}
+        for path, layer in collect_quantizable(model, exclude):
+            impl = (cq.Int4Conv2D(layer, self._group_size)
+                    if isinstance(layer, gnn.Conv2D)
+                    else cq.Int4Dense(layer, self._group_size))
+            twin = _Int4Twin(impl, path)
+            self._entries[id(layer)] = twin
+            self._by_path[path] = twin
+        if not self._entries:
+            raise MXNetError(
+                "Int4WeightAdapter: no quantizable Dense/Conv2D layers "
+                "found in the wrapped adapter's model")
+        per_layer = tuple(sorted(
+            (path, e.digest) for path, e in self._by_path.items()))
+        self._pass = QuantizeInt4Pass(self._entries, self._group_size,
+                                      per_layer)
+        self._init_common(inner)
 
 
 def quantize_adapter(adapter, calib_data, calib_fn: Callable,
@@ -361,3 +477,36 @@ def maybe_quantize_adapter(adapter, calib_data=None, calib_fn=None,
             "step — run quantize_adapter explicitly if that is intended)")
     return quantize_adapter(adapter, calib_data, calib_fn, calib_mode=mode,
                             exclude=exclude)
+
+
+def int4_adapter(adapter, group_size: int = 32,
+                 exclude: Iterable[str] = ()) -> Int4WeightAdapter:
+    """Wrap ``adapter`` for weight-only int4 serving.  No calibration
+    step — packing is a pure function of the weights (group-wise
+    symmetric, ``contrib.quantization._quantize_weight_int4_np``)."""
+    return Int4WeightAdapter(adapter, group_size=group_size,
+                             exclude=exclude)
+
+
+def maybe_int4_adapter(adapter, exclude: Iterable[str] = ()):
+    """The env-driven gate: ``MX_SERVE_INT4=1`` (or ``int4``) wraps
+    ``adapter`` for weight-only int4 serving with the ``MX_QUANT_GROUP``
+    group size (default 32); unset/``0`` returns the adapter untouched.
+    Composing with ``MX_QUANTIZE=int8`` is rejected — the two rewrites
+    claim the same Dense/Conv layers."""
+    raw = (os.environ.get("MX_SERVE_INT4") or "").strip().lower()
+    if raw in ("", "0", "false", "off"):
+        return adapter
+    if raw not in ("1", "int4", "true", "on"):
+        raise MXNetError(f"MX_SERVE_INT4={raw!r}: expected int4/1 or 0/off")
+    if (os.environ.get("MX_QUANTIZE") or "").strip().lower() not in \
+            ("", "0", "false", "off"):
+        raise MXNetError(
+            "MX_SERVE_INT4 and MX_QUANTIZE are both set: the int4 and "
+            "int8 rewrites claim the same Dense/Conv layers — pick one")
+    graw = (os.environ.get("MX_QUANT_GROUP") or "32").strip()
+    try:
+        group = int(graw)
+    except ValueError:
+        raise MXNetError(f"MX_QUANT_GROUP={graw!r}: expected an even int")
+    return int4_adapter(adapter, group_size=group, exclude=exclude)

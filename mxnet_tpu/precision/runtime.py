@@ -27,11 +27,26 @@ from __future__ import annotations
 
 import contextlib
 
+from ..passes import hooks as _hooks
+
 __all__ = ["amp_scope", "amp_active", "cast_inputs", "quant_scope",
            "quant_entry"]
 
 _AMP_POLICY = None   # active AmpPolicy, or None (the fast-path check)
 _QUANT_MAP = None    # active {id(layer): quantized-twin}, or None
+
+
+class _AmpHook(_hooks.OpHook):
+    """The AMP pass's dispatch hook: per-op-class input casts.  Since
+    the pass pipeline, ``ops/registry._invoke_impl`` consults the ONE
+    hook tuple instead of this module's global directly — the cast logic
+    itself is unchanged (``cast_inputs`` below)."""
+
+    def rewrite_inputs(self, op_name, inputs):
+        return cast_inputs(op_name, inputs)
+
+
+_AMP_HOOK = _AmpHook()
 
 
 def amp_active() -> bool:
@@ -46,7 +61,8 @@ def amp_scope(policy):
     prev = _AMP_POLICY
     _AMP_POLICY = policy
     try:
-        yield
+        with _hooks.op_hook(_AMP_HOOK):
+            yield
     finally:
         _AMP_POLICY = prev
 
@@ -54,9 +70,10 @@ def amp_scope(policy):
 def cast_inputs(op_name: str, inputs):
     """Apply the active cast policy to one op call's NDArray inputs.
 
-    Called from ``ops.registry._invoke_impl`` ONLY when a policy is
-    active (the registry checks the module global first, so the AMP-off
-    dispatch path is byte-for-byte unchanged).  Casts are real ops and
+    Reached from ``ops.registry._invoke_impl`` via the pass-pipeline
+    hook (``passes/hooks.py``) ONLY while an amp_scope is active — the
+    hook tuple is empty otherwise, so the AMP-off dispatch path is
+    byte-for-byte unchanged.  Casts are real ops and
     inline into whatever trace is running — that is the graph-level
     pass: the cast decisions are properties of the traced program, not
     of eager per-call wrappers."""

@@ -453,6 +453,14 @@ class ServingEngine:
         # telemetry so dashboards can attribute latency/throughput to
         # the dtype program serving them (docs/PRECISION.md)
         self._precision = str(getattr(adapter, "precision", "fp32"))
+        # the serving pass pipeline (passes/builtin.pipeline_for_serving):
+        # adapter-contributed quant passes + fused-kernel substitution.
+        # Every traced body runs under its scope (_traced), and its ONE
+        # signature joins _fingerprint_parts — config/order changes miss
+        # the AOT cache instead of loading the wrong program.
+        from ..passes.builtin import pipeline_for_serving
+
+        self._pipeline = pipeline_for_serving(adapter)
         self._ctx = ctx if ctx is not None else current_context()
         self._S = slots if slots is not None else env_int("MX_SERVE_SLOTS", 8)
         self._ps = page_size if page_size is not None \
@@ -865,7 +873,11 @@ class ServingEngine:
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(False)
             try:
-                out = body(nds)
+                # every serving executable traces under the pass
+                # pipeline's scope (quant rewrites, fused-kernel
+                # substitution) — one place, all variants
+                with self._pipeline.scope():
+                    out = body(nds)
             finally:
                 end_trace(prev)
                 autograd.set_recording(prev_rec)
@@ -945,6 +957,7 @@ class ServingEngine:
                 + (type(self._adapter).__name__,
                    type(model).__name__ if model is not None else "",
                    tuple(self._adapter.signature()),
+                   self._pipeline.signature(),
                    self._S, self._ps, self._P, self._max_len,
                    self._shape_sig(arg_arrays)))
 

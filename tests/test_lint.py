@@ -25,7 +25,7 @@ _spec.loader.exec_module(mxlint)
 
 
 def lint_src(tmp_path, src, relpath="mxnet_tpu/fixture.py", rules=None,
-             hot_entries=None, env_registry=frozenset()):
+             hot_entries=None, env_registry=frozenset(), pass_entries=None):
     """Write one fixture file under a fake repo root and lint it."""
     path = tmp_path / relpath
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -33,7 +33,8 @@ def lint_src(tmp_path, src, relpath="mxnet_tpu/fixture.py", rules=None,
     findings, stats = mxlint.run_lint(
         [str(path)], root=str(tmp_path), rules=rules,
         hot_entries=hot_entries if hot_entries is not None else {},
-        env_registry=env_registry)
+        env_registry=env_registry,
+        pass_entries=pass_entries if pass_entries is not None else {})
     return findings, stats
 
 
@@ -316,8 +317,10 @@ def test_superstep_entries_registered_and_rename_fails_loudly(tmp_path):
 def test_precision_entries_registered():
     assert mxlint.HOT_PATH_ENTRIES["mxnet_tpu/precision/loss_scale.py"] \
         == ("overflow_flag",)
+    # the decode body lives on the shared rewrite-adapter base since the
+    # int4 path joined int8 (both delegate through it)
     assert mxlint.HOT_PATH_ENTRIES["mxnet_tpu/precision/quantize.py"] \
-        == ("QuantizedAdapter.decode",)
+        == ("_RewriteAdapterBase.decode",)
     amp_entries = mxlint.HOT_PATH_ENTRIES["mxnet_tpu/contrib/amp/amp.py"]
     assert "DynamicLossScaler.has_overflow" in amp_entries
 
@@ -1196,3 +1199,90 @@ def test_every_rule_is_documented():
     doc = open(os.path.join(_REPO, "docs", "STATIC_ANALYSIS.md")).read()
     for rule in mxlint.RULES:
         assert rule in doc, f"rule {rule} missing from docs/STATIC_ANALYSIS.md"
+
+
+# ---------------------------------------------------------------------------
+# pass-outside-pipeline (PR 20: the pass-pipeline dispatch contract)
+# ---------------------------------------------------------------------------
+_PASS_FIXTURE_ENTRIES = {
+    "mxnet_tpu/fixture.py": {
+        "function": "_invoke_impl",
+        "hook_module": "_pass_hooks",
+        "allowed": (("_pass_hooks", "_OP_HOOKS"),),
+    },
+}
+
+
+def test_pass_outside_pipeline_flags_smuggled_global(tmp_path):
+    """The pre-PR-20 pattern — dispatch reading a precision module global
+    directly instead of the pass-hook tuple — fires: a rewrite the
+    pipeline fingerprint cannot see must not land silently."""
+    findings, _ = lint_src(tmp_path, """
+        from .passes import hooks as _pass_hooks
+        from .precision import runtime as _precision
+
+        def _invoke_impl(op, inputs):
+            op_hooks = _pass_hooks._OP_HOOKS
+            if _precision._AMP_POLICY is not None:
+                inputs = [x.astype("bfloat16") for x in inputs]
+            return op.fn(*inputs)
+    """, rules=["pass-outside-pipeline"],
+        pass_entries=_PASS_FIXTURE_ENTRIES)
+    assert rules_of(findings) == ["pass-outside-pipeline"]
+    assert "_precision._AMP_POLICY" in findings[0].message
+    assert "GraphPass" in findings[0].message
+
+
+def test_pass_outside_pipeline_clean_dispatch(tmp_path):
+    """The sanctioned shape — ONE _OP_HOOKS read, locals/op attrs free —
+    is clean; `x._data`-style loads on locals are not module globals."""
+    findings, _ = lint_src(tmp_path, """
+        from .passes import hooks as _pass_hooks
+
+        def _invoke_impl(op, inputs):
+            op_hooks = _pass_hooks._OP_HOOKS
+            if op_hooks and inputs:
+                for h in op_hooks:
+                    inputs = h.rewrite_inputs(op.name, inputs)
+            arrays = [x._data for x in inputs]
+            return op.fn(*arrays)
+    """, rules=["pass-outside-pipeline"],
+        pass_entries=_PASS_FIXTURE_ENTRIES)
+    assert findings == []
+
+
+def test_pass_rule_stale_entry_fails_loudly(tmp_path):
+    """A renamed dispatch body must not silently turn the rule into a
+    no-op (the stale-hot-entry contract, applied here)."""
+    findings, _ = lint_src(tmp_path, """
+        from .passes import hooks as _pass_hooks
+
+        def renamed_dispatch(op, inputs):
+            return _pass_hooks._OP_HOOKS
+    """, rules=["pass-outside-pipeline"],
+        pass_entries=_PASS_FIXTURE_ENTRIES)
+    assert rules_of(findings) == ["pass-outside-pipeline"]
+    assert "does not resolve" in findings[0].message
+
+
+def test_pass_rule_disconnected_hook_fails_loudly(tmp_path):
+    """Deleting the _OP_HOOKS consultation disconnects the whole pass
+    pipeline from dispatch — itself a finding."""
+    findings, _ = lint_src(tmp_path, """
+        from .passes import hooks as _pass_hooks
+
+        def _invoke_impl(op, inputs):
+            return op.fn(*inputs)
+    """, rules=["pass-outside-pipeline"],
+        pass_entries=_PASS_FIXTURE_ENTRIES)
+    assert rules_of(findings) == ["pass-outside-pipeline"]
+    assert "no longer consults" in findings[0].message
+
+
+def test_pass_dispatch_entry_registered():
+    """The real repo's consultation point is pinned, and the live tree
+    is clean under the rule (the 0-findings gate covers it)."""
+    cfg = mxlint.PASS_DISPATCH_ENTRIES["mxnet_tpu/ops/registry.py"]
+    assert cfg["function"] == "_invoke_impl"
+    assert cfg["hook_module"] == "_pass_hooks"
+    assert ("_pass_hooks", "_OP_HOOKS") in cfg["allowed"]

@@ -80,6 +80,8 @@ RULES = {
                       "entry point",
     "bad-suppression": "mxlint suppression naming an unknown rule",
     "stale-hot-entry": "configured hot-path entry point no longer resolves",
+    "pass-outside-pipeline": "op-dispatch body consults module-global pass "
+                             "state outside the pass-hook protocol",
     "syntax-error": "file failed to parse",
 }
 
@@ -103,7 +105,7 @@ HOT_PATH_ENTRIES = {
     # executable — a host sync here would land inside engine tracing or
     # stall the serving pipeline)
     "mxnet_tpu/precision/loss_scale.py": ("overflow_flag",),
-    "mxnet_tpu/precision/quantize.py": ("QuantizedAdapter.decode",),
+    "mxnet_tpu/precision/quantize.py": ("_RewriteAdapterBase.decode",),
     # the eager AMP compatibility shim: scale_loss/has_overflow run per
     # Trainer step — the PR 15 fix replaced its per-gradient asnumpy()
     # loop with ONE fused device reduce; these entries keep the old
@@ -125,6 +127,26 @@ HOT_PATH_ENTRIES = {
         "ServingEngine._dispatch_step", "ServingEngine._dispatch_spec",
         "ServingEngine._decode_body", "ServingEngine._verify_body",
         "ServingEngine._ingest_body"),
+}
+
+# THE pass-pipeline consultation point (docs/PRECISION.md §Pass
+# pipeline): repo-relative path -> the op-dispatch body, the hook-module
+# alias it must consult, and the (module-alias, _attr) loads it is
+# allowed.  Any OTHER `<module>._underscore` load inside the dispatch
+# body is a graph pass smuggled around the pipeline — a module global
+# the pipeline fingerprint cannot see, exactly the one-off pattern the
+# pass registry absorbed.  Like HOT_PATH_ENTRIES, a stale entry (the
+# body renamed away, or the hook consultation deleted) fails loudly
+# instead of turning the rule into a silent no-op.
+PASS_DISPATCH_ENTRIES = {
+    "mxnet_tpu/ops/registry.py": {
+        "function": "_invoke_impl",
+        "hook_module": "_pass_hooks",
+        "allowed": (("_pass_hooks", "_OP_HOOKS"),
+                    # the row-sparse Embedding cotangent type — autograd
+                    # tape plumbing, not trace-rewrite state
+                    ("autograd", "_RowSparseCT")),
+    },
 }
 
 # HTTP handler threads that must NEVER touch jax (repo-relative path ->
@@ -292,7 +314,7 @@ def _docstring_nodes(nodes):
 # ---------------------------------------------------------------------------
 class FileLint:
     def __init__(self, abspath, relpath, text, env_registry, hot_entries,
-                 active_rules, jax_free_entries=None):
+                 active_rules, jax_free_entries=None, pass_entries=None):
         self.path = relpath
         self.text = text
         self.lines = text.splitlines()
@@ -300,6 +322,8 @@ class FileLint:
         self.hot_entries = hot_entries
         self.jax_free = (jax_free_entries if jax_free_entries is not None
                          else JAX_FREE_ENTRIES)
+        self.pass_entries = (pass_entries if pass_entries is not None
+                             else PASS_DISPATCH_ENTRIES)
         self.active = active_rules
         self.findings = []
         self.suppressed = 0
@@ -425,6 +449,7 @@ class FileLint:
             ("hot-sync", self.rule_hot_path),
             ("retrace-hazard", self.rule_static_argnums),
             ("jax-in-handler", self.rule_jax_free),
+            ("pass-outside-pipeline", self.rule_pass_pipeline),
         )
         for rule, fn in passes:
             if rule in self.active or (
@@ -921,6 +946,68 @@ class FileLint:
                     # per-step sync would
                     self._check_sync_call(node, qual)
 
+    # -- pass-outside-pipeline --------------------------------------------
+    def rule_pass_pipeline(self):
+        """The op-dispatch body may consult module-global trace-rewrite
+        state ONLY through the pass-hook protocol: the one
+        ``_pass_hooks._OP_HOOKS`` read (plus explicitly allowed
+        non-pass plumbing).  Any other ``<module>._underscore`` load in
+        the body is a pass smuggled around the pipeline — invisible to
+        the pipeline fingerprint, so two different traced programs
+        would collide on one AOT cache key."""
+        cfg = self.pass_entries.get(self.path)
+        if not cfg:
+            return
+        qual = cfg["function"]
+        fn = self.scopes.functions.get(qual)
+        if fn is None:
+            # a renamed/moved dispatch body must not silently turn the
+            # rule into a no-op — same contract as stale-hot-entry
+            self._emit(
+                "pass-outside-pipeline", 1, 0, qual,
+                f"configured dispatch body {qual!r} (PASS_DISPATCH_ENTRIES "
+                f"in tools/mxlint.py) does not resolve in this file — "
+                f"update the entry to the renamed/moved dispatch point")
+            return
+        hook_mod = cfg.get("hook_module")
+        allowed = {tuple(a) for a in cfg.get("allowed", ())}
+        # names bound by ANY import in the file (incl. `from .. import
+        # autograd` inside functions): only module aliases are candidate
+        # global-state carriers — locals like `x._data` are not
+        imported = set()
+        for n in self.all_nodes:
+            if isinstance(n, (ast.Import, ast.ImportFrom)):
+                for a in n.names:
+                    imported.add((a.asname or a.name).split(".")[0])
+        saw_hook = False
+        for node in self._nodes_in(fn):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in imported
+                    and node.attr.startswith("_")):
+                continue
+            pair = (node.value.id, node.attr)
+            if pair in allowed:
+                if pair[0] == hook_mod:
+                    saw_hook = True
+                continue
+            self._emit(
+                "pass-outside-pipeline", node.lineno, node.col_offset,
+                qual,
+                f"dispatch body consults {pair[0]}.{pair[1]} — "
+                f"module-global pass state outside the pass-hook "
+                f"protocol; register a GraphPass (passes/pipeline.py) "
+                f"whose scope pushes an OpHook, and let the one "
+                f"{hook_mod}._OP_HOOKS read carry it")
+        if hook_mod and not saw_hook:
+            self._emit(
+                "pass-outside-pipeline", fn.lineno, fn.col_offset, qual,
+                f"dispatch body no longer consults "
+                f"{hook_mod}._OP_HOOKS — the pass pipeline is "
+                f"disconnected from dispatch (or the consultation moved: "
+                f"update PASS_DISPATCH_ENTRIES in tools/mxlint.py)")
+
     # -- retrace-hazard part 2: unhashable static args --------------------
     def rule_static_argnums(self):
         jitted = {}  # name -> static positions
@@ -1018,12 +1105,12 @@ def _rel(path, root):
 
 
 def run_lint(paths=None, root=None, rules=None, hot_entries=None,
-             env_registry=None, jax_free_entries=None):
+             env_registry=None, jax_free_entries=None, pass_entries=None):
     """Analyze `paths` (files or dirs); returns (findings, stats).
 
     `rules`: iterable restricting which rules run (default: all).
-    `hot_entries`/`env_registry`/`jax_free_entries`: overrides for
-    tests/fixtures.
+    `hot_entries`/`env_registry`/`jax_free_entries`/`pass_entries`:
+    overrides for tests/fixtures.
     """
     root = root or REPO
     paths = list(paths) if paths else list(DEFAULT_PATHS)
@@ -1052,7 +1139,7 @@ def run_lint(paths=None, root=None, rules=None, hot_entries=None,
             raise ValueError(f"cannot read {ap}: {e}")
         nfiles += 1
         fl = FileLint(ap, rel, text, env_registry, entries, active,
-                      jax_free_entries=jax_free)
+                      jax_free_entries=jax_free, pass_entries=pass_entries)
         findings.extend(fl.run())
         suppressed += fl.suppressed
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
